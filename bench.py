@@ -1,0 +1,126 @@
+"""Driver benchmark: TPC-H Q1 scan-aggregate throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+North star (BASELINE.md): rows/sec/chip on Q1 scan-agg; vs_baseline is the
+speedup over a vectorized numpy implementation of the same query on the
+host CPU (the stand-in for the reference's SIMD CPU executor,
+src/sql/engine/aggregate/ob_hash_groupby_vec_op.cpp path).
+
+Env: BENCH_SF (default 1.0), BENCH_ITERS (default 5), BENCH_QUERY (q1|q6).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def numpy_q1(li, cutoff):
+    sel = li["l_shipdate"] <= cutoff
+    rf = li["l_returnflag"][sel]
+    ls = li["l_linestatus"][sel]
+    qty = li["l_quantity"][sel]
+    price = li["l_extendedprice"][sel]
+    disc = li["l_discount"][sel]
+    tax = li["l_tax"][sel]
+    # dictionary-encode group keys then aggregate with bincount segments
+    key = rf.astype("U1")
+    ukeys, codes = np.unique(np.char.add(key, ls.astype("U1")), return_inverse=True)
+    disc_price = price * (100 - disc)
+    charge = disc_price * (100 + tax)
+    out = {}
+    out["sum_qty"] = np.bincount(codes, qty)
+    out["sum_base_price"] = np.bincount(codes, price)
+    out["sum_disc_price"] = np.bincount(codes, disc_price)
+    out["sum_charge"] = np.bincount(codes, charge)
+    out["count"] = np.bincount(codes)
+    out["avg_qty"] = out["sum_qty"] / out["count"]
+    out["avg_price"] = out["sum_base_price"] / out["count"]
+    out["avg_disc"] = np.bincount(codes, disc) / out["count"]
+    return ukeys, out
+
+
+def numpy_q6(li, d0, d1):
+    sel = (
+        (li["l_shipdate"] >= d0) & (li["l_shipdate"] < d1)
+        & (li["l_discount"] >= 5) & (li["l_discount"] <= 7)
+        & (li["l_quantity"] < 2400)
+    )
+    return (li["l_extendedprice"][sel] * li["l_discount"][sel]).sum()
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "1.0"))
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+    which = os.environ.get("BENCH_QUERY", "q1")
+
+    import jax
+
+    from oceanbase_tpu.bench.queries import q1_plan, q6_plan
+    from oceanbase_tpu.bench.tpch import gen_tpch
+    from oceanbase_tpu.datatypes import SqlType, date_to_days
+    from oceanbase_tpu.exec.plan import _lower
+    from oceanbase_tpu.vector import from_numpy, to_numpy
+
+    t0 = time.time()
+    tables, types = gen_tpch(sf=sf)
+    li = tables["lineitem"]
+    n_rows = len(li["l_orderkey"])
+    print(f"# generated SF{sf} lineitem: {n_rows} rows in {time.time()-t0:.1f}s",
+          file=sys.stderr)
+
+    plan = q1_plan() if which == "q1" else q6_plan()
+    needed = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+              "l_discount", "l_tax", "l_shipdate"]
+    rel = from_numpy({k: li[k] for k in needed},
+                     types={k: v for k, v in types.items() if k in needed})
+    dev_tables = {"lineitem": rel}
+
+    run = jax.jit(lambda t: _lower(plan, t))
+    t0 = time.time()
+    out = jax.block_until_ready(run(dev_tables))
+    compile_s = time.time() - t0
+    print(f"# compile+first-run: {compile_s:.1f}s", file=sys.stderr)
+
+    times = []
+    for _ in range(iters):
+        t0 = time.time()
+        out = jax.block_until_ready(run(dev_tables))
+        times.append(time.time() - t0)
+    dev_time = min(times)
+
+    # host numpy baseline
+    cutoff = date_to_days("1998-09-02")
+    t0 = time.time()
+    if which == "q1":
+        numpy_q1(li, cutoff)
+    else:
+        numpy_q6(li, date_to_days("1994-01-01"), date_to_days("1995-01-01"))
+    cpu_time = time.time() - t0
+
+    # sanity: compare engine vs numpy result
+    res = to_numpy(out)
+    if which == "q1":
+        _, oracle = numpy_q1(li, cutoff)
+        assert np.array_equal(res["sum_qty"], oracle["sum_qty"]), "Q1 mismatch"
+
+    rows_per_sec = n_rows / dev_time
+    platform = jax.devices()[0].platform
+    print(json.dumps({
+        "metric": f"tpch_{which}_sf{sf:g}_rows_per_sec_chip",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(cpu_time / dev_time, 3),
+        "device_time_s": round(dev_time, 4),
+        "numpy_cpu_time_s": round(cpu_time, 4),
+        "rows": n_rows,
+        "platform": platform,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""oceanbase_tpu — a TPU-native distributed HTAP SQL database framework.
+
+A from-scratch re-design of OceanBase's capabilities (reference:
+/root/reference, see SURVEY.md) with the execution plane on TPU:
+
+- ``vector/``   columnar batch formats in HBM (analog of src/share/vector)
+- ``expr/``     expression IR + JAX compiler (analog of src/sql/engine/expr)
+- ``exec/``     vectorized physical operators (analog of src/sql/engine)
+- ``px/``       parallel execution over a device mesh (analog of src/sql/engine/px + src/sql/dtl)
+- ``sql/``      parser / resolver / rewrite / optimizer / code generator / plan cache
+                (analog of src/sql/{parser,resolver,rewrite,optimizer,code_generator,plan_cache})
+- ``storage/``  LSM-lite column store + memtable (analog of src/storage)
+- ``tx/``       MVCC transactions, GTS, 2PC (analog of src/storage/tx)
+- ``palf/``     replicated log + election (analog of src/logservice/palf)
+- ``server/``   sessions, tenants, config, observability (analog of src/observer)
+
+Control plane runs on host; the compute plane (scan/filter/agg/join/exchange)
+is JAX/XLA over TPU with mesh collectives for the PX exchange.
+"""
+
+import jax
+
+# The engine computes on exact 64-bit integers (decimals are scaled int64,
+# reference: ObNumber / VEC_TC_DEC_INT* in src/share/vector/ob_vector_define.h:47-51).
+# TPU emulates i64 with i32 pairs; correctness first, Pallas split kernels later.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

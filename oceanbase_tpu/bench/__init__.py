@@ -1,0 +1,10 @@
+"""Benchmark harness: TPC-H data generation + query suite.
+
+The reference's headline numbers are TPC-H/TPC-C (README.md:44); the
+driver's BASELINE.json ladder is TPC-H Q6/Q1/Q14/Q9 then the 22-query
+suite.  ``tpch.py`` is a vectorized numpy dbgen analog (self-consistent
+schema + distributions approximating the spec closely enough that every
+query has non-degenerate selectivity); correctness is checked against a
+SQLite oracle on the same generated data (≙ mysqltest result diffing,
+tools/deploy/mysql_test).
+"""

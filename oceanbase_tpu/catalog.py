@@ -1,0 +1,134 @@
+"""Catalog: schemas, tables, statistics.
+
+Reference analog: the schema service (src/share/schema,
+ObMultiVersionSchemaService src/share/schema/ob_multi_version_schema_service.h:151)
+plus optimizer statistics (src/share/stat).  Round-1 scope: an in-memory
+catalog versioned by a monotonically increasing schema version; the storage
+engine (storage/) persists and reloads it.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from oceanbase_tpu.datatypes import SqlType
+from oceanbase_tpu.vector import Relation, from_numpy
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    dtype: SqlType
+    nullable: bool = True
+
+
+@dataclass
+class TableDef:
+    name: str
+    columns: list[ColumnDef]
+    primary_key: list[str] = field(default_factory=list)
+    # optimizer stats (≙ src/share/stat basic table stats)
+    row_count: int = 0
+    ndv: dict[str, int] = field(default_factory=dict)
+
+    def column(self, name: str) -> ColumnDef:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(name)
+
+    def has_column(self, name: str) -> bool:
+        return any(c.name == name for c in self.columns)
+
+    @property
+    def column_names(self) -> list[str]:
+        return [c.name for c in self.columns]
+
+
+class Catalog:
+    """Named tables -> (definition, device-resident data).
+
+    Thread-safe; schema_version bumps on DDL (≙ schema refresh protocol)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._defs: dict[str, TableDef] = {}
+        self._data: dict[str, Relation] = {}
+        self.schema_version = 1
+
+    # -- DDL -------------------------------------------------------------
+    def create_table(self, tdef: TableDef, if_not_exists: bool = False):
+        with self._lock:
+            if tdef.name in self._defs:
+                if if_not_exists:
+                    return
+                raise ValueError(f"table {tdef.name} already exists")
+            self._defs[tdef.name] = tdef
+            self.schema_version += 1
+
+    def drop_table(self, name: str, if_exists: bool = False):
+        with self._lock:
+            if name not in self._defs:
+                if if_exists:
+                    return
+                raise KeyError(name)
+            del self._defs[name]
+            self._data.pop(name, None)
+            self.schema_version += 1
+
+    # -- data ------------------------------------------------------------
+    def load_numpy(self, name: str, arrays: dict[str, np.ndarray],
+                   types: dict[str, SqlType] | None = None,
+                   primary_key: list[str] | None = None,
+                   valids: dict[str, np.ndarray] | None = None):
+        """Bulk-load host arrays as a table (≙ direct load path,
+        src/storage/direct_load)."""
+        rel = from_numpy(arrays, types=types, valids=valids)
+        n = rel.capacity
+        cols = []
+        ndv = {}
+        for cname in arrays:
+            col = rel.columns[cname]
+            cols.append(ColumnDef(cname, col.dtype, nullable=col.valid is not None))
+            if col.sdict is not None:
+                ndv[cname] = col.sdict.size
+            else:
+                ndv[cname] = max(1, min(n, int(n ** 0.8)))
+        with self._lock:
+            self._defs[name] = TableDef(
+                name, cols, primary_key=primary_key or [], row_count=n, ndv=ndv
+            )
+            self._data[name] = rel
+            self.schema_version += 1
+
+    def set_data(self, name: str, rel: Relation):
+        with self._lock:
+            self._data[name] = rel
+            d = self._defs.get(name)
+            if d is not None:
+                d.row_count = rel.capacity
+
+    # -- lookup ----------------------------------------------------------
+    def table_def(self, name: str) -> TableDef:
+        with self._lock:
+            if name not in self._defs:
+                raise KeyError(f"unknown table {name}")
+            return self._defs[name]
+
+    def table_data(self, name: str) -> Relation:
+        with self._lock:
+            if name not in self._data:
+                raise KeyError(f"table {name} has no data")
+            return self._data[name]
+
+    def has_table(self, name: str) -> bool:
+        with self._lock:
+            return name in self._defs
+
+    def tables(self) -> list[str]:
+        with self._lock:
+            return sorted(self._defs)

@@ -1,0 +1,27 @@
+"""Vectorized physical operators on TPU.
+
+Reference analog: the static-engine operator set under src/sql/engine
+(ObOperator::get_next_batch, src/sql/engine/ob_operator.cpp:1466).  The TPU
+re-design replaces the volcano batch loop with whole-column dataflow: each
+operator is a pure function Relation -> Relation traced into one XLA
+program per plan (morsel streaming over HBM-sized inputs is layered on top,
+see px/granule.py).  Data-dependent cardinalities live behind static
+capacities + masks (SURVEY §7 hard part (a)).
+"""
+
+from oceanbase_tpu.exec.ops import (
+    AggSpec,
+    compact,
+    filter_rows,
+    hash_groupby,
+    join,
+    limit,
+    project,
+    scalar_agg,
+    sort_rows,
+)
+
+__all__ = [
+    "AggSpec", "filter_rows", "project", "hash_groupby", "scalar_agg",
+    "join", "sort_rows", "limit", "compact",
+]

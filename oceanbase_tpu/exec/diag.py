@@ -1,0 +1,46 @@
+"""Execution diagnostics lane: overflow accounting under jit.
+
+XLA programs can't raise, so data-dependent failures (static-capacity
+overflow in joins/exchanges — SURVEY §7 hard part (a)) are accumulated as
+traced scalars into an active collector during lowering; the executor
+bundles them into the compiled function's outputs and checks them on the
+host after the run, failing loudly instead of returning truncated results.
+
+Reference analog: the defensive result checks the reference compiles in
+(ENABLE_SANITY expr-output checker, src/sql/engine/ob_operator.cpp:1556)
+plus DTL flow-control backpressure (src/sql/dtl/ob_dtl_flow_control.h) —
+which on TPU becomes "detect that the static buffer budget was exceeded
+and re-plan with larger capacity".
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+
+_collector: contextvars.ContextVar[list | None] = contextvars.ContextVar(
+    "ob_tpu_diag", default=None
+)
+
+
+@contextlib.contextmanager
+def collect():
+    """Activate a collector; yields the list that traced entries land in."""
+    entries: list[tuple[str, object]] = []
+    tok = _collector.set(entries)
+    try:
+        yield entries
+    finally:
+        _collector.reset(tok)
+
+
+def push(name: str, scalar) -> None:
+    """Record a traced overflow scalar (no-op outside a collector)."""
+    entries = _collector.get()
+    if entries is not None:
+        entries.append((name, scalar))
+
+
+class CapacityOverflow(RuntimeError):
+    """Raised by the executor when an operator exceeded its static
+    capacity; callers re-plan with a larger budget (spill in later rounds)."""

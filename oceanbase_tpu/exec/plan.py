@@ -1,0 +1,236 @@
+"""Physical plan nodes + whole-plan compiler.
+
+Reference analog: the ObOpSpec tree produced by the code generator
+(ObStaticEngineCG, src/sql/code_generator/ob_static_engine_cg.h:188) and
+driven by ObOperator::get_next_batch (src/sql/engine/ob_operator.cpp:1466).
+The TPU build compiles the *entire* plan (or DFO fragment) into one XLA
+program: plan nodes are specs; ``compile_plan`` lowers them to a pure
+function {table -> Relation} -> Relation which is jitted and cached.
+
+Operator profiling (≙ op_monitor_info_, src/sql/engine/ob_operator.cpp:1534)
+hooks at this layer via the plan monitor (server/monitor.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+
+from oceanbase_tpu.exec import diag, ops
+from oceanbase_tpu.exec.ops import AggSpec
+from oceanbase_tpu.expr import ir
+from oceanbase_tpu.vector.column import Relation
+
+
+class PlanNode:
+    """Immutable physical operator spec (≙ ObOpSpec)."""
+
+    def children(self) -> Sequence["PlanNode"]:
+        return ()
+
+    def fingerprint(self) -> str:
+        """Stable key for the plan cache."""
+        return repr(self)
+
+
+@dataclass(repr=True)
+class TableScan(PlanNode):
+    table: str
+    columns: Optional[list[str]] = None  # projection pushdown
+    rename: Optional[dict[str, str]] = None  # output qualification
+
+
+@dataclass(repr=True)
+class Filter(PlanNode):
+    child: PlanNode
+    pred: ir.Expr
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(repr=True)
+class Project(PlanNode):
+    child: PlanNode
+    outputs: dict  # name -> ir.Expr
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(repr=True)
+class GroupBy(PlanNode):
+    child: PlanNode
+    keys: dict  # name -> ir.Expr
+    aggs: list  # list[AggSpec]
+    out_capacity: Optional[int] = None
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(repr=True)
+class ScalarAgg(PlanNode):
+    child: PlanNode
+    aggs: list
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(repr=True)
+class HashJoin(PlanNode):
+    left: PlanNode
+    right: PlanNode
+    left_keys: list
+    right_keys: list
+    how: str = "inner"
+    out_capacity: Optional[int] = None
+
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclass(repr=True)
+class Sort(PlanNode):
+    child: PlanNode
+    keys: list
+    ascending: Optional[list] = None
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(repr=True)
+class Limit(PlanNode):
+    child: PlanNode
+    k: int
+    offset: int = 0
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(repr=True)
+class Compact(PlanNode):
+    """Explicit cardinality-reduction point (densify live rows)."""
+
+    child: PlanNode
+    capacity: Optional[int] = None
+
+    def children(self):
+        return (self.child,)
+
+
+# ---------------------------------------------------------------------------
+# lowering
+# ---------------------------------------------------------------------------
+
+
+def _lower(node: PlanNode, tables: dict[str, Relation]) -> Relation:
+    if isinstance(node, TableScan):
+        rel = tables[node.table]
+        if node.columns is not None:
+            rel = rel.select(node.columns)
+        if node.rename:
+            rel = Relation(
+                columns={node.rename.get(n, n): c for n, c in rel.columns.items()},
+                mask=rel.mask,
+            )
+        return rel
+    if isinstance(node, Filter):
+        return ops.filter_rows(_lower(node.child, tables), node.pred)
+    if isinstance(node, Project):
+        return ops.project(_lower(node.child, tables), node.outputs)
+    if isinstance(node, GroupBy):
+        return ops.hash_groupby(
+            _lower(node.child, tables), node.keys, node.aggs,
+            out_capacity=node.out_capacity,
+        )
+    if isinstance(node, ScalarAgg):
+        return ops.scalar_agg(_lower(node.child, tables), node.aggs)
+    if isinstance(node, HashJoin):
+        return ops.join(
+            _lower(node.left, tables), _lower(node.right, tables),
+            node.left_keys, node.right_keys, how=node.how,
+            out_capacity=node.out_capacity,
+        )
+    if isinstance(node, Sort):
+        return ops.sort_rows(_lower(node.child, tables), node.keys, node.ascending)
+    if isinstance(node, Limit):
+        return ops.limit(_lower(node.child, tables), node.k, node.offset)
+    if isinstance(node, Compact):
+        return ops.compact(_lower(node.child, tables), node.capacity)
+    raise NotImplementedError(type(node).__name__)
+
+
+def referenced_tables(node: PlanNode) -> set[str]:
+    out = set()
+    if isinstance(node, TableScan):
+        out.add(node.table)
+    for c in node.children():
+        out |= referenced_tables(c)
+    return out
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled(plan_key, plan_holder):
+    plan = plan_holder.plan
+    diag_names: list[str] = []  # filled at trace time
+
+    @jax.jit
+    def run(tables):
+        with diag.collect() as entries:
+            out = _lower(plan, tables)
+        diag_names.clear()
+        diag_names.extend(n for n, _ in entries)
+        return out, [v for _, v in entries]
+
+    return run, diag_names
+
+
+class _PlanHolder:
+    """Hashable wrapper so lru_cache can key on the fingerprint while the
+    plan object rides along."""
+
+    def __init__(self, plan: PlanNode, key: str):
+        self.plan = plan
+        self.key = key
+
+    def __hash__(self):
+        return hash(self.key)
+
+    def __eq__(self, other):
+        return isinstance(other, _PlanHolder) and other.key == self.key
+
+
+def execute_plan(plan: PlanNode, tables: dict[str, Relation],
+                 check_overflow: bool = True) -> Relation:
+    """Compile (cached) + run a plan against device tables.
+
+    ≙ ObExecutor::execute_plan (src/sql/executor/ob_executor.cpp:37); the
+    compilation cache here is the engine-level analog of the plan cache
+    (ObPlanCache::get_plan, src/sql/plan_cache/ob_plan_cache.cpp:579).
+
+    Raises diag.CapacityOverflow when any static-capacity operator
+    (join expansion, exchange buffer) overflowed — results would be
+    silently truncated otherwise; the caller re-plans with larger budgets.
+    """
+    key = plan.fingerprint()
+    needed = referenced_tables(plan)
+    run, diag_names = _compiled(key, _PlanHolder(plan, key))
+    out, diag_vals = run({k: v for k, v in tables.items() if k in needed})
+    if check_overflow and diag_vals:
+        vals = [int(v) for v in diag_vals]
+        if any(v > 0 for v in vals):
+            detail = ", ".join(
+                f"{n}={v}" for n, v in zip(diag_names, vals) if v > 0
+            )
+            raise diag.CapacityOverflow(
+                f"operator capacity exceeded ({detail} rows dropped); "
+                f"re-plan with larger out_capacity"
+            )
+    return out

@@ -1,0 +1,36 @@
+"""Expression engine: IR + JAX compiler.
+
+Reference analog: the flat ObExpr array with vectorized eval
+(src/sql/engine/expr/ob_expr.h:516, ObExpr::eval_vector
+src/sql/engine/expr/ob_expr.cpp:1378).  Where the reference needs
+CG-laid-out frames and three eval ABIs, the TPU build compiles an expression
+DAG straight into a fused jax computation over whole column vectors — XLA is
+the frame allocator and the fusion engine.  Null semantics ride as a second
+(value, valid) lane per sub-expression.
+"""
+
+from oceanbase_tpu.expr.ir import (
+    AggCall,
+    Arith,
+    Case,
+    Cast,
+    Cmp,
+    ColumnRef,
+    Expr,
+    FuncCall,
+    InList,
+    IsNull,
+    Like,
+    Literal,
+    Logic,
+    Not,
+    lit,
+    col,
+)
+from oceanbase_tpu.expr.compile import eval_expr, eval_predicate
+
+__all__ = [
+    "Expr", "ColumnRef", "Literal", "Arith", "Cmp", "Logic", "Not", "InList",
+    "Like", "Case", "Cast", "FuncCall", "IsNull", "AggCall",
+    "lit", "col", "eval_expr", "eval_predicate",
+]

@@ -1,0 +1,30 @@
+"""PX — intra-query parallel execution over a TPU device mesh.
+
+Reference analog: the PX framework + DTL data transport
+(src/sql/engine/px — ObPxCoordOp ob_px_coord_op.h:25, DFOs ob_dfo.h:475;
+src/sql/dtl — ObDtlChannel ob_dtl_channel.h:86).
+
+TPU mapping (SURVEY §2.3/§2.4):
+- a DFO (plan fragment × dop workers)  -> one shard_map'd program over the mesh
+- DTL channel matrix                   -> XLA collectives over ICI
+- HASH / PKEY repartition              -> bucket-sort + all_to_all
+- BROADCAST                            -> all_gather
+- datahub (barrier/rollup/range)       -> psum / allgather
+- granule iterator                     -> per-shard row ranges (px/granule.py)
+- flow control                         -> static: fixed per-destination
+  capacities chosen by the planner (XLA collectives are synchronous; the
+  reference's credit windows become compile-time buffer budgets)
+"""
+
+from oceanbase_tpu.px.exchange import (
+    all_to_all_repartition,
+    broadcast_gather,
+    default_mesh,
+    shard_relation,
+    unshard_relation,
+)
+
+__all__ = [
+    "default_mesh", "shard_relation", "unshard_relation",
+    "all_to_all_repartition", "broadcast_gather",
+]

@@ -1,0 +1,161 @@
+"""Distributed operators: partial-agg + repartition + final-agg, dist join.
+
+Reference analog: the two-DFO group-by / join shapes the PX planner emits
+(partial agg DFO -> HASH exchange -> final agg DFO; ob_dfo_mgr.h:19 splits
+at ObLogExchange boundaries).  Here each "DFO pair + exchange" is one
+shard_map'd function; the exchange is an all_to_all inside it.
+
+Aggregate split mirrors the reference's partial/final aggregate rewrite
+(ObHashGroupByVecOp in a PX plan computes partials; the final DFO merges):
+    sum   -> sum of partial sums        count -> sum of partial counts
+    min   -> min of partial mins        max   -> max of partial maxs
+    avg   -> sum(psum)/sum(pcount) as a post-projection
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from oceanbase_tpu.exec.ops import AggSpec, hash_groupby
+from oceanbase_tpu.expr import ir
+from oceanbase_tpu.px.exchange import (
+    PX_AXIS,
+    all_to_all_repartition,
+    shard_relation,
+    unshard_relation,
+)
+from oceanbase_tpu.vector.column import Relation
+
+
+def split_aggs(aggs: Sequence[AggSpec]):
+    """-> (partial_specs, final_specs, post_projection exprs)."""
+    partial_specs: list[AggSpec] = []
+    final_specs: list[AggSpec] = []
+    post: dict[str, ir.Expr] = {}
+    for a in aggs:
+        if a.fn in ("sum", "count", "count_star"):
+            pname = f"__p_{a.name}"
+            if a.fn == "count_star":
+                partial_specs.append(AggSpec(pname, "count_star"))
+            else:
+                partial_specs.append(AggSpec(pname, a.fn, a.arg))
+            final_specs.append(AggSpec(a.name, "sum", ir.col(pname)))
+            post[a.name] = ir.col(a.name)
+        elif a.fn in ("min", "max"):
+            pname = f"__p_{a.name}"
+            partial_specs.append(AggSpec(pname, a.fn, a.arg))
+            final_specs.append(AggSpec(a.name, a.fn, ir.col(pname)))
+            post[a.name] = ir.col(a.name)
+        elif a.fn == "avg":
+            ps, pc = f"__ps_{a.name}", f"__pc_{a.name}"
+            partial_specs.append(AggSpec(ps, "sum", a.arg))
+            partial_specs.append(AggSpec(pc, "count", a.arg))
+            fs, fc = f"__fs_{a.name}", f"__fc_{a.name}"
+            final_specs.append(AggSpec(fs, "sum", ir.col(ps)))
+            final_specs.append(AggSpec(fc, "sum", ir.col(pc)))
+            post[a.name] = ir.Arith("/", ir.col(fs), ir.col(fc))
+        else:
+            raise NotImplementedError(f"distributed {a.fn}")
+    return partial_specs, final_specs, post
+
+
+def dist_groupby_shard(
+    rel: Relation,
+    keys: dict[str, ir.Expr],
+    aggs: Sequence[AggSpec],
+    ndev: int,
+    local_cap: int,
+    out_cap: int,
+    axis_name: str = PX_AXIS,
+):
+    """Per-shard body (call inside shard_map): partial agg -> all_to_all by
+    group-key hash -> final agg.  Each chip ends up owning a disjoint set of
+    groups.  Returns (relation, global overflow count) — overflow > 0 means
+    an exchange buffer was too small and rows were dropped; callers must
+    fail or re-plan (see exec/diag.py)."""
+    partial_specs, final_specs, post = split_aggs(aggs)
+    local, l_ovf = hash_groupby(rel, keys, partial_specs,
+                                out_capacity=local_cap, return_overflow=True)
+    key_cols = [ir.col(k) for k in keys]
+    recv, x_ovf = all_to_all_repartition(
+        local, key_cols, ndev, cap_per_dest=local_cap, axis_name=axis_name
+    )
+    final, f_ovf = hash_groupby(
+        recv, {k: ir.col(k) for k in keys}, final_specs,
+        out_capacity=out_cap, return_overflow=True,
+    )
+    # post-projection (avg) keeping group key columns
+    from oceanbase_tpu.exec.ops import project  # local import to avoid cycle
+
+    outs = {k: ir.col(k) for k in keys}
+    outs.update(post)
+    overflow = jax.lax.psum(l_ovf + x_ovf + f_ovf, axis_name)
+    return project(final, outs), overflow
+
+
+def dist_groupby(
+    rel: Relation,
+    keys: dict[str, ir.Expr],
+    aggs: Sequence[AggSpec],
+    mesh,
+    local_cap: int = 4096,
+    out_cap: int = 4096,
+) -> Relation:
+    """Host entry: shard a relation over the mesh, run the distributed
+    group-by, return the merged (unsharded) result relation."""
+    axis = mesh.axis_names[0]
+    ndev = mesh.devices.size
+    sharded = shard_relation(rel, mesh, axis)
+
+    fn = partial(
+        dist_groupby_shard, keys=keys, aggs=aggs, ndev=ndev,
+        local_cap=local_cap, out_cap=out_cap, axis_name=axis,
+    )
+    spec = P(axis)
+    run = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec,), out_specs=(spec, P()),
+            check_vma=False,
+        )
+    )
+    out, overflow = run(sharded)
+    if int(overflow) > 0:
+        from oceanbase_tpu.exec.diag import CapacityOverflow
+
+        raise CapacityOverflow(
+            f"exchange buffer overflow: {int(overflow)} rows dropped; "
+            f"increase local_cap"
+        )
+    return unshard_relation(out)
+
+
+def dist_join_shard(
+    left: Relation,
+    right: Relation,
+    left_keys: Sequence[ir.Expr],
+    right_keys: Sequence[ir.Expr],
+    ndev: int,
+    cap_per_dest: int,
+    out_capacity: int,
+    how: str = "inner",
+    axis_name: str = PX_AXIS,
+):
+    """HASH-HASH distributed join: repartition both inputs on the join key
+    so matching keys co-locate, then local sort-join per chip
+    (≙ PX HASH dist join, ObSliceIdxCalc::SliceCalcType HASH both sides).
+
+    Returns (relation, global overflow count); see dist_groupby_shard."""
+    from oceanbase_tpu.exec.ops import join
+
+    lrecv, lov = all_to_all_repartition(left, left_keys, ndev, cap_per_dest,
+                                        axis_name)
+    rrecv, rov = all_to_all_repartition(right, right_keys, ndev, cap_per_dest,
+                                        axis_name)
+    out = join(lrecv, rrecv, left_keys, right_keys, how=how,
+               out_capacity=out_capacity)
+    return out, jax.lax.psum(lov + rov, axis_name)

@@ -1,0 +1,181 @@
+"""PX exchange: repartition/broadcast between plan fragments via collectives.
+
+Reference analog: ObPxTransmitOp slice calc + DTL send
+(src/sql/engine/px/exchange/ob_px_transmit_op.cpp:576,
+src/sql/engine/px/ob_slice_calc.h:73) and ObPxReceiveOp channel polling
+(src/sql/engine/px/exchange/ob_px_receive_op.h:83).
+
+On TPU the transmit/receive pair collapses into one collective:
+
+    HASH / PKEY   -> bucket the rows by hash(keys) % ndev, pack into a
+                     [ndev, cap] send buffer, jax.lax.all_to_all over ICI
+    BROADCAST     -> jax.lax.all_gather
+    datahub       -> jax.lax.psum
+
+Everything here runs *inside* shard_map over the mesh axis — the per-shard
+view is the PX worker (SQC task analog).  Capacities are static: the
+planner budgets cap_per_dest; overflow rows are counted into a diagnostics
+lane rather than silently dropped (≙ DTL flow-control backpressure made
+compile-time).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oceanbase_tpu.datatypes import SqlType
+from oceanbase_tpu.exec.ops import _combined_key, _mix64  # shared key mixers
+from oceanbase_tpu.expr import ir
+from oceanbase_tpu.expr.compile import eval_expr
+from oceanbase_tpu.vector.column import Column, Relation
+
+PX_AXIS = "px"
+
+
+def default_mesh(n_devices: int | None = None, axis: str = PX_AXIS):
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return jax.sharding.Mesh(np.array(devs), (axis,))
+
+
+# ---------------------------------------------------------------------------
+# host-side sharding of whole tables onto the mesh (granule assignment)
+# ---------------------------------------------------------------------------
+
+
+def shard_relation(rel: Relation, mesh, axis: str = PX_AXIS) -> Relation:
+    """Row-shard a device relation across the mesh (block distribution).
+
+    ≙ granule->worker assignment (ObGranulePump::fetch_granule_task,
+    src/sql/engine/px/ob_granule_pump.cpp:361) made static: contiguous row
+    ranges per chip.  Pads capacity to a multiple of the mesh size; the pad
+    rows are masked dead.
+    """
+    ndev = mesh.devices.size
+    n = rel.capacity
+    cap = ((n + ndev - 1) // ndev) * ndev
+    sharding = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(axis)
+    )
+    mask = np.ones(n, dtype=bool) if rel.mask is None else np.asarray(rel.mask)
+    pad_mask = np.zeros(cap, dtype=bool)
+    pad_mask[:n] = mask
+
+    cols = {}
+    for name, c in rel.columns.items():
+        d = np.asarray(c.data)
+        pad = np.zeros((cap - n,) + d.shape[1:], dtype=d.dtype)
+        d2 = jax.device_put(np.concatenate([d, pad]), sharding)
+        v2 = None
+        if c.valid is not None:
+            v = np.asarray(c.valid)
+            v2 = jax.device_put(
+                np.concatenate([v, np.zeros(cap - n, dtype=bool)]), sharding
+            )
+        cols[name] = Column(d2, v2, c.dtype, c.sdict)
+    return Relation(columns=cols, mask=jax.device_put(pad_mask, sharding))
+
+
+def unshard_relation(rel: Relation) -> Relation:
+    """Gather a sharded relation back to one addressable array set."""
+    cols = {
+        n: Column(jnp.asarray(c.data), None if c.valid is None else
+                  jnp.asarray(c.valid), c.dtype, c.sdict)
+        for n, c in rel.columns.items()
+    }
+    m = None if rel.mask is None else jnp.asarray(rel.mask)
+    return Relation(columns=cols, mask=m)
+
+
+# ---------------------------------------------------------------------------
+# in-SPMD exchanges (call inside shard_map)
+# ---------------------------------------------------------------------------
+
+
+def _hash_dest(rel: Relation, keys: Sequence[ir.Expr], ndev: int):
+    cols = [eval_expr(e, rel) for e in keys]
+    k, _ = _combined_key(cols)
+    h = _mix64(k.astype(jnp.uint64))
+    return (h % jnp.uint64(ndev)).astype(jnp.int32)
+
+
+def all_to_all_repartition(
+    rel: Relation,
+    keys: Sequence[ir.Expr],
+    ndev: int,
+    cap_per_dest: int,
+    axis_name: str = PX_AXIS,
+) -> tuple[Relation, jnp.ndarray]:
+    """HASH-repartition the local shard across the mesh axis.
+
+    Returns (received relation with capacity ndev*cap_per_dest, local
+    overflow count).  Rows with the same key hash land on the same chip.
+    ≙ ObSliceIdxCalc hash slice + DTL send/recv, as one all_to_all.
+    """
+    n = rel.capacity
+    m = rel.mask_or_true()
+    dest = jnp.where(m, _hash_dest(rel, keys, ndev), ndev)  # dead -> sentinel
+
+    order = jnp.argsort(dest, stable=True)
+    s_dest = jnp.take(dest, order)
+    # rank within destination bucket
+    counts = jnp.bincount(s_dest, length=ndev + 1)
+    start = jnp.cumsum(counts) - counts
+    pos_in_bucket = jnp.arange(n) - jnp.take(start, s_dest)
+    live_lane = (s_dest < ndev) & (pos_in_bucket < cap_per_dest)
+    overflow = jnp.sum((s_dest < ndev) & (pos_in_bucket >= cap_per_dest))
+
+    slot = jnp.where(
+        live_lane, s_dest.astype(jnp.int64) * cap_per_dest + pos_in_bucket,
+        ndev * cap_per_dest,  # spill slot (dropped)
+    )
+
+    def scatter(x, fill=0):
+        buf = jnp.full((ndev * cap_per_dest + 1,) + x.shape[1:], fill, x.dtype)
+        return buf.at[slot].set(jnp.take(x, order, axis=0))[:-1]
+
+    recv_cols = {}
+    sent_mask = scatter(m.astype(jnp.int8)).astype(jnp.bool_)
+    # reshape to [ndev, cap] and exchange
+    ex_mask = _a2a(sent_mask.reshape(ndev, cap_per_dest), axis_name)
+    for name, c in rel.columns.items():
+        sd = scatter(c.data)
+        rd = _a2a(sd.reshape((ndev, cap_per_dest) + sd.shape[1:]), axis_name)
+        rv = None
+        if c.valid is not None:
+            sv = scatter(c.valid.astype(jnp.int8)).astype(jnp.bool_)
+            rv = _a2a(sv.reshape(ndev, cap_per_dest), axis_name).reshape(-1)
+        recv_cols[name] = Column(
+            rd.reshape((ndev * cap_per_dest,) + rd.shape[2:]), rv, c.dtype, c.sdict
+        )
+    out = Relation(columns=recv_cols, mask=ex_mask.reshape(-1))
+    return out, overflow
+
+
+def _a2a(x, axis_name):
+    return jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=False)
+
+
+def broadcast_gather(rel: Relation, axis_name: str = PX_AXIS) -> Relation:
+    """BROADCAST distribution: every chip receives every shard's rows
+    (≙ ObSliceIdxCalc BROADCAST + bc2host; on TPU it's one all_gather)."""
+    cols = {}
+    for name, c in rel.columns.items():
+        d = jax.lax.all_gather(c.data, axis_name, axis=0, tiled=True)
+        v = None
+        if c.valid is not None:
+            v = jax.lax.all_gather(c.valid, axis_name, axis=0, tiled=True)
+        cols[name] = Column(d, v, c.dtype, c.sdict)
+    m = jax.lax.all_gather(rel.mask_or_true(), axis_name, axis=0, tiled=True)
+    return Relation(columns=cols, mask=m)
+
+
+def datahub_psum(x, axis_name: str = PX_AXIS):
+    """Coordinator-mediated aggregation (≙ PX datahub,
+    src/sql/engine/px/datahub/components/) — semantically an allreduce."""
+    return jax.lax.psum(x, axis_name)

@@ -1,0 +1,37 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's tiering (SURVEY §4): unit tests construct operators
+with synthetic inputs (≙ unittest/sql/engine fake table scan), multi-device
+tests use the forced host platform mesh (≙ mittest in-process cluster).
+"""
+
+import os
+
+# must be set before jax initializes any backend; force-override — the
+# environment pins JAX_PLATFORMS to the real TPU tunnel, which unit tests
+# must never touch
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize registers the real-TPU PJRT plugin in every
+# interpreter and pins platform selection to it; creating that client from
+# a test process would hang on / contend for the single tunnel.  Drop the
+# factory before any backend is instantiated.
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

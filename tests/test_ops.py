@@ -1,0 +1,194 @@
+"""Operator unit tests vs numpy/python oracles.
+
+≙ unittest/sql/engine operator tests with the fake table scan feeding
+synthetic vectors (unittest/sql/engine/ob_fake_table_scan_vec_op.h)."""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.exec import (
+    AggSpec,
+    compact,
+    filter_rows,
+    hash_groupby,
+    join,
+    limit,
+    scalar_agg,
+    sort_rows,
+)
+from oceanbase_tpu.expr import ir
+from oceanbase_tpu.vector import from_numpy, to_numpy
+
+
+def test_filter_and_compact(rng):
+    n = 5000
+    rel = from_numpy({"a": rng.integers(0, 100, n), "b": rng.integers(0, 5, n)})
+    a = np.asarray(rel.columns["a"].data)
+    out = filter_rows(rel, ir.col("a") < 30)
+    assert int(out.count()) == int((a < 30).sum())
+    c = compact(out)
+    got = to_numpy(c)["a"]
+    np.testing.assert_array_equal(np.sort(got), np.sort(a[a < 30]))
+
+
+def test_groupby_sums(rng):
+    n = 10000
+    a = rng.integers(0, 7, n)
+    v = rng.integers(-50, 50, n)
+    rel = from_numpy({"g": a, "v": v})
+    out = hash_groupby(
+        rel,
+        {"g": ir.col("g")},
+        [
+            AggSpec("s", "sum", ir.col("v")),
+            AggSpec("c", "count_star"),
+            AggSpec("mn", "min", ir.col("v")),
+            AggSpec("mx", "max", ir.col("v")),
+            AggSpec("av", "avg", ir.col("v")),
+        ],
+        out_capacity=64,
+    )
+    res = to_numpy(out)
+    order = np.argsort(res["g"])
+    for k in res:
+        res[k] = res[k][order]
+    keys = np.unique(a)
+    np.testing.assert_array_equal(res["g"], keys)
+    np.testing.assert_array_equal(res["s"], [v[a == k].sum() for k in keys])
+    np.testing.assert_array_equal(res["c"], [(a == k).sum() for k in keys])
+    np.testing.assert_array_equal(res["mn"], [v[a == k].min() for k in keys])
+    np.testing.assert_array_equal(res["mx"], [v[a == k].max() for k in keys])
+    np.testing.assert_allclose(res["av"], [v[a == k].mean() for k in keys])
+
+
+def test_groupby_multi_key_with_nulls(rng):
+    n = 2000
+    g1 = rng.integers(0, 3, n)
+    g2 = rng.integers(0, 4, n)
+    nulls = rng.random(n) < 0.1
+    v = rng.integers(0, 100, n)
+    rel = from_numpy({"g1": g1, "g2": g2, "v": v},
+                     valids={"g2": ~nulls})
+    out = hash_groupby(rel, {"g1": ir.col("g1"), "g2": ir.col("g2")},
+                       [AggSpec("c", "count_star")])
+    res = to_numpy(out)
+    # oracle: nulls form their own group per g1
+    import collections
+    oracle = collections.Counter()
+    for i in range(n):
+        key = (g1[i], None if nulls[i] else g2[i])
+        oracle[key] += 1
+    assert len(res["g1"]) == len(oracle)
+    got_total = res["c"].sum()
+    assert got_total == n
+
+
+def test_count_distinct(rng):
+    n = 3000
+    g = rng.integers(0, 5, n)
+    v = rng.integers(0, 20, n)
+    rel = from_numpy({"g": g, "v": v})
+    out = hash_groupby(rel, {"g": ir.col("g")},
+                       [AggSpec("cd", "count_distinct", ir.col("v"))],
+                       out_capacity=16)
+    res = to_numpy(out)
+    order = np.argsort(res["g"])
+    np.testing.assert_array_equal(
+        res["cd"][order], [len(np.unique(v[g == k])) for k in np.unique(g)]
+    )
+
+
+def test_scalar_agg_empty_and_nulls():
+    rel = from_numpy({"x": np.array([1, 2, 3, 4])},
+                     valids={"x": np.array([True, True, False, False])})
+    rel = filter_rows(rel, ir.col("x") < 0)  # empty
+    out = scalar_agg(rel, [AggSpec("c", "count", ir.col("x")),
+                           AggSpec("s", "sum", ir.col("x")),
+                           AggSpec("n", "count_star")])
+    res = to_numpy(out)
+    assert res["c"][0] == 0 and res["n"][0] == 0
+    assert not np.asarray(out.columns["s"].valid)[0]  # SUM of empty = NULL
+
+
+def test_inner_join_pk_fk(rng):
+    nl, nr = 5000, 200
+    fk = rng.integers(0, nr, nl)
+    lval = rng.integers(0, 1000, nl)
+    rval = rng.integers(0, 1000, nr)
+    left = from_numpy({"fk": fk, "lv": lval})
+    right = from_numpy({"pk": np.arange(nr), "rv": rval})
+    out = join(left, right, [ir.col("fk")], [ir.col("pk")], how="inner",
+               out_capacity=nl)
+    res = to_numpy(out)
+    assert len(res["fk"]) == nl
+    np.testing.assert_array_equal(res["fk"], res["pk"])
+    np.testing.assert_array_equal(res["rv"], rval[res["fk"]])
+
+
+def test_join_duplicates_and_semi_anti(rng):
+    left = from_numpy({"k": np.array([1, 2, 3, 4]), "lv": np.array([10, 20, 30, 40])})
+    right = from_numpy({"rk": np.array([2, 2, 3, 9]), "rv": np.array([1, 2, 3, 4])})
+    out = join(left, right, [ir.col("k")], [ir.col("rk")], how="inner",
+               out_capacity=16)
+    res = to_numpy(out)
+    pairs = sorted(zip(res["k"].tolist(), res["rv"].tolist()))
+    assert pairs == [(2, 1), (2, 2), (3, 3)]
+
+    semi = join(left, right, [ir.col("k")], [ir.col("rk")], how="semi")
+    np.testing.assert_array_equal(np.sort(to_numpy(semi)["k"]), [2, 3])
+
+    anti = join(left, right, [ir.col("k")], [ir.col("rk")], how="anti")
+    np.testing.assert_array_equal(np.sort(to_numpy(anti)["k"]), [1, 4])
+
+
+def test_left_join(rng):
+    left = from_numpy({"k": np.array([1, 2, 3]), "lv": np.array([10, 20, 30])})
+    right = from_numpy({"rk": np.array([2, 2]), "rv": np.array([7, 8])})
+    out = join(left, right, [ir.col("k")], [ir.col("rk")], how="left",
+               out_capacity=8)
+    res = to_numpy(out)
+    assert sorted(res["k"].tolist()) == [1, 2, 2, 3]
+    rv_valid = np.asarray(out.columns["rv"].valid)[
+        np.nonzero(np.asarray(out.mask_or_true()))[0]]
+    assert rv_valid.sum() == 2  # only the two matched rows have rv
+
+
+def test_multikey_join(rng):
+    n = 1000
+    k1 = rng.integers(0, 10, n)
+    k2 = rng.integers(0, 10, n)
+    left = from_numpy({"a1": k1, "a2": k2, "lv": np.arange(n)})
+    rk1 = np.repeat(np.arange(10), 10)
+    rk2 = np.tile(np.arange(10), 10)
+    right = from_numpy({"b1": rk1, "b2": rk2, "rv": np.arange(100)})
+    out = join(left, right, [ir.col("a1"), ir.col("a2")],
+               [ir.col("b1"), ir.col("b2")], how="inner", out_capacity=n)
+    res = to_numpy(out)
+    assert len(res["a1"]) == n  # every (k1,k2) pair exists exactly once
+    np.testing.assert_array_equal(res["a1"], res["b1"])
+    np.testing.assert_array_equal(res["a2"], res["b2"])
+    np.testing.assert_array_equal(res["rv"], res["a1"] * 10 + res["a2"])
+
+
+def test_sort_and_limit(rng):
+    n = 1000
+    a = rng.integers(0, 100, n)
+    b = rng.integers(0, 100, n)
+    rel = from_numpy({"a": a, "b": b})
+    out = limit(sort_rows(rel, [ir.col("a"), ir.col("b")], [True, False]), 10)
+    res = to_numpy(out)
+    oracle = sorted(zip(a.tolist(), (-b).tolist()))[:10]
+    got = list(zip(res["a"].tolist(), (-res["b"]).tolist()))
+    assert got == oracle
+
+
+def test_join_string_keys_different_dicts():
+    left = from_numpy({"name": np.array(["fr", "de", "us", "cn"]),
+                       "lv": np.array([1, 2, 3, 4])})
+    right = from_numpy({"rname": np.array(["de", "us", "jp"]),
+                        "rv": np.array([10, 20, 30])})
+    out = join(left, right, [ir.col("name")], [ir.col("rname")], how="inner",
+               out_capacity=8)
+    res = to_numpy(out)
+    pairs = sorted(zip(res["name"].tolist(), res["rv"].tolist()))
+    assert pairs == [("de", 10), ("us", 20)]

@@ -1,0 +1,122 @@
+"""Regression tests for the round-1 code-review findings."""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.datatypes import SqlType, date_to_days
+from oceanbase_tpu.exec import AggSpec, hash_groupby, join, sort_rows
+from oceanbase_tpu.exec.diag import CapacityOverflow
+from oceanbase_tpu.exec.plan import HashJoin, TableScan, execute_plan
+from oceanbase_tpu.expr import ir
+from oceanbase_tpu.expr.compile import US_PER_DAY, eval_expr, eval_predicate
+from oceanbase_tpu.vector import from_numpy, to_numpy
+
+
+def test_join_overflow_raises():
+    left = from_numpy({"k": np.array([1, 1, 1, 1])})
+    right = from_numpy({"rk": np.array([1, 1, 1, 1])})
+    plan = HashJoin(TableScan("l"), TableScan("r"),
+                    [ir.col("k")], [ir.col("rk")], how="inner",
+                    out_capacity=4)  # true output is 16
+    with pytest.raises(CapacityOverflow):
+        execute_plan(plan, {"l": left, "r": right})
+    # sufficient capacity succeeds
+    plan2 = HashJoin(TableScan("l"), TableScan("r"),
+                     [ir.col("k")], [ir.col("rk")], how="inner",
+                     out_capacity=16)
+    out = execute_plan(plan2, {"l": left, "r": right})
+    assert int(out.count()) == 16
+
+
+def test_datetime_literal_compare_microseconds():
+    us = np.array([0, US_PER_DAY, date_to_days("1994-06-01") * US_PER_DAY])
+    rel = from_numpy({"ts": us}, types={"ts": SqlType.datetime()})
+    p = eval_predicate(ir.col("ts") >= ir.lit("1994-01-01"), rel)
+    np.testing.assert_array_equal(np.asarray(p), [False, False, True])
+    # with a time component
+    p = eval_predicate(ir.col("ts") < ir.lit("1970-01-01 00:00:01"), rel)
+    np.testing.assert_array_equal(np.asarray(p), [True, False, False])
+
+
+def test_count_distinct_null_key_group():
+    rel = from_numpy(
+        {"k": np.array([0, 0, 5, 5]), "v": np.array([10, 20, 30, 30])},
+        valids={"k": np.array([True, False, True, True])},
+    )
+    out = hash_groupby(rel, {"k": ir.col("k")},
+                       [AggSpec("cd", "count_distinct", ir.col("v"))])
+    res = to_numpy(out)
+    # groups: k=0 -> {10}, k=NULL -> {20}, k=5 -> {30}
+    assert sorted(res["cd"].tolist()) == [1, 1, 1]
+    assert len(res["cd"]) == 3
+
+
+def test_inlist_decimal_scale_down():
+    rel = from_numpy({"d": np.array([5, 7, 50])},  # 0.05, 0.07, 0.50
+                     types={"d": SqlType.decimal(15, 2)})
+    p = eval_predicate(
+        ir.col("d").isin([ir.lit("0.050", SqlType.decimal()),
+                          ir.lit("0.071", SqlType.decimal()),
+                          ir.lit("0.5", SqlType.decimal())]), rel)
+    np.testing.assert_array_equal(np.asarray(p), [True, False, True])
+
+
+def test_sort_nulls_mysql_order():
+    rel = from_numpy({"x": np.array([3, 0, 2])},
+                     valids={"x": np.array([True, False, True])})
+    out = sort_rows(rel, [ir.col("x")], [True])
+    got = np.asarray(out.columns["x"].valid)
+    assert not got[0] and got[1] and got[2]  # NULL first under ASC
+    np.testing.assert_array_equal(np.asarray(out.columns["x"].data)[1:], [2, 3])
+    out = sort_rows(rel, [ir.col("x")], [False])
+    got = np.asarray(out.columns["x"].valid)
+    assert got[0] and got[1] and not got[2]  # NULL last under DESC
+    np.testing.assert_array_equal(np.asarray(out.columns["x"].data)[:2], [3, 2])
+
+
+def test_arith_reversed_date_and_datetime():
+    days = np.array([date_to_days("1994-01-01")])
+    rel = from_numpy({"d": days, "ts": days.astype(np.int64) * US_PER_DAY},
+                     types={"d": SqlType.date(), "ts": SqlType.datetime()})
+    c = eval_expr(ir.Arith("+", ir.lit(5), ir.col("d")), rel)
+    assert int(c.data[0]) == date_to_days("1994-01-06")
+    c = eval_expr(ir.col("ts") + ir.lit(1), rel)
+    assert int(c.data[0]) == date_to_days("1994-01-02") * US_PER_DAY
+    c = eval_expr(ir.col("d") - ir.lit("1993-12-31", SqlType.date()), rel)
+    assert int(c.data[0]) == 1
+    with pytest.raises(TypeError):
+        eval_expr(ir.Arith("-", ir.lit(5), ir.col("d")), rel)
+
+
+def test_case_string_branches():
+    rel = from_numpy({"s": np.array(["a", "b", "c"])})
+    e = ir.Case(whens=[(ir.col("s").eq(ir.lit("a")), ir.lit("hit"))],
+                else_=ir.lit("miss"))
+    c = eval_expr(e, rel)
+    assert c.sdict is not None
+    vals = c.sdict.values[np.asarray(c.data)]
+    np.testing.assert_array_equal(vals, ["hit", "miss", "miss"])
+    # coalesce over strings keeps a dictionary too
+    e2 = ir.FuncCall("coalesce", [ir.col("s"), ir.lit("x")])
+    c2 = eval_expr(e2, rel)
+    assert c2.sdict is not None
+
+
+def test_dist_exchange_overflow_raises(rng):
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from oceanbase_tpu.px.dist_ops import dist_groupby
+    from oceanbase_tpu.px.exchange import default_mesh
+
+    mesh = default_mesh(8)
+    n = 4096
+    # many distinct keys with a tiny per-stage capacity must raise instead
+    # of silently dropping groups
+    g = rng.integers(0, 4096, n)
+    rel = from_numpy({"g": g, "v": rng.integers(0, 10, n)})
+    with pytest.raises(CapacityOverflow):
+        dist_groupby(rel, {"g": ir.col("g")},
+                     [AggSpec("s", "sum", ir.col("v"))],
+                     mesh, local_cap=8, out_cap=4096)

@@ -1,0 +1,90 @@
+"""End-to-end TPC-H slice tests (Q6/Q1/Q14 at tiny SF) vs numpy oracles.
+
+≙ the reference's mysqltest result-diff tier (SURVEY §4 tier 4) at unit
+scale: run the whole plan through the engine and diff numbers computed by
+an independent numpy implementation.
+"""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.bench.queries import q1_plan, q14_plan, q6_plan
+from oceanbase_tpu.bench.tpch import TPCH_PRIMARY_KEYS, gen_tpch
+from oceanbase_tpu.catalog import Catalog
+from oceanbase_tpu.datatypes import date_to_days
+from oceanbase_tpu.exec.plan import execute_plan
+from oceanbase_tpu.vector import to_numpy
+
+
+@pytest.fixture(scope="module")
+def db():
+    tables, types = gen_tpch(sf=0.01)
+    cat = Catalog()
+    for name, arrays in tables.items():
+        cat.load_numpy(name, arrays,
+                       types={k: v for k, v in types.items() if k in arrays},
+                       primary_key=TPCH_PRIMARY_KEYS[name])
+    return cat, tables
+
+
+def _table_data(cat):
+    return {t: cat.table_data(t) for t in cat.tables()}
+
+
+def test_q6(db):
+    cat, tables = db
+    li = tables["lineitem"]
+    d0, d1 = date_to_days("1994-01-01"), date_to_days("1995-01-01")
+    sel = (
+        (li["l_shipdate"] >= d0) & (li["l_shipdate"] < d1)
+        & (li["l_discount"] >= 5) & (li["l_discount"] <= 7)
+        & (li["l_quantity"] < 2400)
+    )
+    oracle = (li["l_extendedprice"][sel] * li["l_discount"][sel]).sum()
+
+    out = execute_plan(q6_plan(), _table_data(cat))
+    res = to_numpy(out)
+    assert res["revenue"][0] == oracle  # exact fixed-point (scale 4)
+
+
+def test_q1(db):
+    cat, tables = db
+    li = tables["lineitem"]
+    cutoff = date_to_days("1998-09-02")
+    sel = li["l_shipdate"] <= cutoff
+    out = execute_plan(q1_plan(), _table_data(cat))
+    res = to_numpy(out)
+
+    import collections
+    groups = collections.defaultdict(list)
+    for i in np.nonzero(sel)[0]:
+        groups[(li["l_returnflag"][i], li["l_linestatus"][i])].append(i)
+    keys = sorted(groups)
+    assert [tuple(x) for x in zip(res["l_returnflag"], res["l_linestatus"])] == keys
+    for row, k in enumerate(keys):
+        idx = np.array(groups[k])
+        assert res["sum_qty"][row] == li["l_quantity"][idx].sum()
+        assert res["sum_base_price"][row] == li["l_extendedprice"][idx].sum()
+        disc = li["l_extendedprice"][idx] * (100 - li["l_discount"][idx])
+        assert res["sum_disc_price"][row] == disc.sum()
+        charge = disc * (100 + li["l_tax"][idx])
+        assert res["sum_charge"][row] == charge.sum()
+        assert res["count_order"][row] == len(idx)
+        np.testing.assert_allclose(
+            res["avg_qty"][row], li["l_quantity"][idx].mean() / 100, rtol=1e-12
+        )
+
+
+def test_q14(db):
+    cat, tables = db
+    li, part = tables["lineitem"], tables["part"]
+    d0, d1 = date_to_days("1995-09-01"), date_to_days("1995-10-01")
+    sel = (li["l_shipdate"] >= d0) & (li["l_shipdate"] < d1)
+    ptype = part["p_type"][li["l_partkey"][sel] - 1].astype(str)
+    disc = li["l_extendedprice"][sel] * (100 - li["l_discount"][sel])
+    promo = disc[np.char.startswith(ptype, "PROMO")].sum()
+    oracle = 100.0 * promo / disc.sum()
+
+    out = execute_plan(q14_plan(len(li["l_orderkey"])), _table_data(cat))
+    res = to_numpy(out)
+    np.testing.assert_allclose(res["promo_revenue"][0], oracle, rtol=1e-9)
