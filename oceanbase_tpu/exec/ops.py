@@ -37,7 +37,7 @@ from oceanbase_tpu.datatypes import SqlType, TypeKind
 from oceanbase_tpu.exec import diag
 from oceanbase_tpu.expr import ir
 from oceanbase_tpu.expr.compile import cast_column, eval_expr, eval_predicate
-from oceanbase_tpu.vector.column import Column, Relation
+from oceanbase_tpu.vector.column import Column, Relation, StringDict
 
 # ---------------------------------------------------------------------------
 # basics
@@ -460,6 +460,9 @@ def join(
     ln, rn = left.capacity, right.capacity
     lm, rm = left.mask_or_true(), right.mask_or_true()
 
+    if not left_keys:  # cross join: constant key matches everything
+        left_keys = [ir.Literal(0)]
+        right_keys = [ir.Literal(0)]
     lcols = [eval_expr(e, left) for e in left_keys]
     rcols = [eval_expr(e, right) for e in right_keys]
     # string keys across different dictionaries: translate left into right's
@@ -546,6 +549,85 @@ def join(
             live = live & ok
 
     return Relation(columns=out_cols, mask=live)
+
+
+def semi_join_residual(
+    left: Relation,
+    right: Relation,
+    left_keys: Sequence[ir.Expr],
+    right_keys: Sequence[ir.Expr],
+    residual: Sequence[ir.Expr],
+    anti: bool = False,
+    out_capacity: int | None = None,
+) -> Relation:
+    """Semi/anti join with non-equality correlated predicates.
+
+    ≙ the reference's semi-join with other_join_conds (hash join NON-EQUI
+    conditions in ObHashJoinVecOp).  Strategy: expand the equality join,
+    evaluate the residual on the combined rows, then reduce matches per
+    probe row (segment_sum over the probe index) — EXISTS keeps rows with
+    >0 surviving matches, NOT EXISTS keeps rows with 0.
+    """
+    ln = left.capacity
+    lm = left.mask_or_true()
+    # tag probe rows with their position so matches fold back per-row
+    rid = Column(jnp.arange(ln, dtype=jnp.int64), None, SqlType.int_())
+    left2 = Relation(columns={**left.columns, "__rid__": rid}, mask=left.mask)
+    expanded = join(left2, right, left_keys, right_keys, how="inner",
+                    out_capacity=out_capacity)
+    ok = expanded.mask_or_true()
+    for pred in residual:
+        from oceanbase_tpu.expr.compile import eval_predicate
+
+        ok = ok & eval_predicate(pred, expanded)
+    ridx = jnp.clip(expanded.columns["__rid__"].data, 0, ln - 1)
+    matches = jax.ops.segment_sum(ok.astype(jnp.int64), ridx,
+                                  num_segments=ln)
+    if anti:
+        return left.with_mask(lm & (matches == 0))
+    return left.with_mask(lm & (matches > 0))
+
+
+def concat(rels: Sequence[Relation]) -> Relation:
+    """UNION ALL: stack relations (same column ids) into one.
+
+    String columns with different dictionaries are re-encoded into a merged
+    dictionary (host work at trace time, device gather to remap).
+    """
+    names = list(rels[0].columns)
+    out_cols: dict[str, Column] = {}
+    for name in names:
+        cols = [r.columns[name] for r in rels]
+        if any(c.sdict is not None for c in cols):
+            dicts = [c.sdict for c in cols if c.sdict is not None]
+            if all(d is dicts[0] for d in dicts):
+                merged = dicts[0]
+            else:
+                allvals = np.unique(np.concatenate([d.values for d in dicts]))
+                merged = StringDict(allvals)
+                new_cols = []
+                for c in cols:
+                    remap = np.searchsorted(
+                        merged.values, c.sdict.values).astype(np.int32)
+                    codes = jnp.asarray(remap)[
+                        jnp.clip(c.data, 0, c.sdict.size - 1)]
+                    new_cols.append(Column(codes, c.valid, c.dtype, merged))
+                cols = new_cols
+            data = jnp.concatenate([c.data for c in cols])
+            out_cols[name] = Column(data, _concat_valid(cols),
+                                    cols[0].dtype, merged)
+            continue
+        data = jnp.concatenate([c.data.astype(cols[0].data.dtype)
+                                for c in cols])
+        out_cols[name] = Column(data, _concat_valid(cols), cols[0].dtype)
+    mask = jnp.concatenate([r.mask_or_true() for r in rels])
+    return Relation(columns=out_cols, mask=mask)
+
+
+def _concat_valid(cols):
+    if all(c.valid is None for c in cols):
+        return None
+    return jnp.concatenate([c.valid_or_true() for c in cols])
 
 
 def _translate_dict(lc: Column, rc: Column) -> Column:

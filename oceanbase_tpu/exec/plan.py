@@ -95,6 +95,33 @@ class HashJoin(PlanNode):
 
 
 @dataclass(repr=True)
+class SemiJoinResidual(PlanNode):
+    """Semi/anti join with residual (non-equality correlated) predicates;
+    out_capacity budgets the equality-expansion intermediate."""
+
+    left: PlanNode
+    right: PlanNode
+    left_keys: list
+    right_keys: list
+    residual: list
+    anti: bool = False
+    out_capacity: Optional[int] = None
+
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclass(repr=True)
+class Union(PlanNode):
+    """UNION ALL (concat); distinct layered via GroupBy above."""
+
+    inputs: list
+
+    def children(self):
+        return tuple(self.inputs)
+
+
+@dataclass(repr=True)
 class Sort(PlanNode):
     child: PlanNode
     keys: list
@@ -158,6 +185,14 @@ def _lower(node: PlanNode, tables: dict[str, Relation]) -> Relation:
             node.left_keys, node.right_keys, how=node.how,
             out_capacity=node.out_capacity,
         )
+    if isinstance(node, SemiJoinResidual):
+        return ops.semi_join_residual(
+            _lower(node.left, tables), _lower(node.right, tables),
+            node.left_keys, node.right_keys, node.residual,
+            anti=node.anti, out_capacity=node.out_capacity,
+        )
+    if isinstance(node, Union):
+        return ops.concat([_lower(c, tables) for c in node.inputs])
     if isinstance(node, Sort):
         return ops.sort_rows(_lower(node.child, tables), node.keys, node.ascending)
     if isinstance(node, Limit):

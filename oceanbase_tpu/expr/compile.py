@@ -64,13 +64,16 @@ def literal_value(e: ir.Literal):
     if t.kind == TypeKind.DATE and isinstance(v, str):
         v = date_to_days(v)
     if t.kind == TypeKind.DECIMAL and isinstance(v, str):
-        # exact decimal parse: '0.06' with scale from text
+        # exact decimal parse: '0.06' with scale from text; trailing zeros
+        # stripped so '0.0001000000' costs scale 4, not 10 (keeps products
+        # inside int64 range)
         neg = v.startswith("-")
         body = v.lstrip("+-")
         if "." in body:
             ip, fp = body.split(".")
         else:
             ip, fp = body, ""
+        fp = fp.rstrip("0")
         scale = len(fp)
         iv = int(ip or "0") * _POW10[scale] + int(fp or "0")
         v = -iv if neg else iv
@@ -440,6 +443,13 @@ def _eval_arith(e: ir.Arith, rel: Relation, n: int) -> Column:
 
     if e.op == "*":
         ct = mul_result(ta, tb)
+        if ct.kind == TypeKind.DECIMAL and ct.scale > 10:
+            # combined fixed-point scale would overflow int64 on large
+            # aggregates: fall back to double (MySQL keeps DECIMAL(65,30)
+            # via wide ints; exact wide-decimal kernels are a later round)
+            fa, fb = _to_float(a, TypeKind.DOUBLE), _to_float(b, TypeKind.DOUBLE)
+            return Column(data=fa.data * fb.data, valid=valid,
+                          dtype=SqlType.double())
         if ct.kind == TypeKind.DECIMAL:
             data = a.data.astype(jnp.int64) * b.data.astype(jnp.int64)
             return Column(data=data, valid=valid, dtype=ct)

@@ -1,0 +1,20 @@
+"""SQL frontend: lexer -> parser -> resolver -> rewrite -> optimizer ->
+code generator -> plan cache.
+
+Reference analog: the compile pipeline in SURVEY §2.1/§3.2
+(ObSql::stmt_query, src/sql/ob_sql.cpp:152): flex/bison parser
+(src/sql/parser), resolver (src/sql/resolver), rewrite rules
+(src/sql/rewrite), CBO (src/sql/optimizer), static-engine CG
+(src/sql/code_generator) and plan cache (src/sql/plan_cache).
+
+The TPU build uses a hand-written recursive-descent parser (MySQL dialect
+subset), the same IR for raw and engine exprs (JAX tracing removes the
+frame/codegen split), decorrelation rewrites that turn subqueries into
+semi/anti/aggregate joins, a DP join-order optimizer fed by catalog stats,
+and a fingerprint-keyed plan cache in front of XLA compilation.
+"""
+
+from oceanbase_tpu.sql.session import Result, Session
+
+__all__ = ["Session", "Result"]
+

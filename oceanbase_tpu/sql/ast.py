@@ -1,0 +1,169 @@
+"""Statement AST produced by the parser.
+
+Reference analog: ParseNode trees + the resolver's ObDMLStmt
+(src/sql/resolver/dml/ob_dml_stmt.h) — collapsed: the parser directly
+produces typed statement dataclasses; expressions use the shared IR
+(oceanbase_tpu.expr.ir) extended with frontend-only nodes (Subquery, Star,
+Param) that the resolver/rewriter eliminate before codegen.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from oceanbase_tpu.datatypes import SqlType
+from oceanbase_tpu.expr import ir
+
+
+# ---- frontend-only expression nodes ---------------------------------------
+
+@dataclass(eq=False)
+class Star(ir.Expr):
+    """SELECT * or t.*"""
+
+    table: Optional[str] = None
+
+
+@dataclass(eq=False)
+class Param(ir.Expr):
+    """? placeholder (prepared statements / parameterized plan cache)."""
+
+    index: int = 0
+
+
+@dataclass(eq=False)
+class Subquery(ir.Expr):
+    """(SELECT ...) appearing inside an expression.
+
+    kind: 'scalar' | 'exists' | 'in' | 'quant'
+    """
+
+    select: "SelectStmt" = None
+    kind: str = "scalar"
+    negated: bool = False
+    # for IN / quantified compare:
+    lhs: Optional[ir.Expr] = None
+    op: Optional[str] = None       # =, <, ... for ANY/ALL
+    quant: Optional[str] = None    # any | all
+
+    def children(self):
+        return (self.lhs,) if self.lhs is not None else ()
+
+
+# ---- FROM clause -----------------------------------------------------------
+
+@dataclass
+class TableRef:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class SubqueryRef:
+    select: "SelectStmt"
+    alias: str
+
+
+@dataclass
+class JoinRef:
+    left: object
+    right: object
+    kind: str  # inner | left | right | cross
+    on: Optional[ir.Expr] = None
+
+
+# ---- statements ------------------------------------------------------------
+
+@dataclass
+class OrderItem:
+    expr: ir.Expr
+    ascending: bool = True
+
+
+@dataclass
+class SelectStmt:
+    items: list = field(default_factory=list)      # list[(Expr, alias|None)]
+    from_: list = field(default_factory=list)      # list[TableRef|SubqueryRef|JoinRef]
+    where: Optional[ir.Expr] = None
+    group_by: list = field(default_factory=list)   # list[Expr]
+    having: Optional[ir.Expr] = None
+    order_by: list = field(default_factory=list)   # list[OrderItem]
+    limit: Optional[int] = None
+    offset: int = 0
+    distinct: bool = False
+    ctes: list = field(default_factory=list)       # list[(name, SelectStmt)]
+    setops: list = field(default_factory=list)     # list[(op, all, SelectStmt)]
+    # ORDER BY / LIMIT written after a set operation apply to the combined
+    # result, not the last branch:
+    post_order_by: list = field(default_factory=list)
+    post_limit: Optional[int] = None
+    post_offset: int = 0
+
+
+@dataclass
+class ColumnSpec:
+    name: str
+    dtype: SqlType
+    nullable: bool = True
+    primary_key: bool = False
+
+
+@dataclass
+class CreateTableStmt:
+    name: str
+    columns: list  # list[ColumnSpec]
+    primary_key: list = field(default_factory=list)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTableStmt:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class InsertStmt:
+    table: str
+    columns: list            # list[str] or [] for all
+    rows: list = None        # list[list[Expr]] for VALUES
+    select: SelectStmt = None
+
+
+@dataclass
+class UpdateStmt:
+    table: str
+    assignments: list        # list[(col, Expr)]
+    where: Optional[ir.Expr] = None
+
+
+@dataclass
+class DeleteStmt:
+    table: str
+    where: Optional[ir.Expr] = None
+
+
+@dataclass
+class ExplainStmt:
+    stmt: object
+
+
+@dataclass
+class ShowTablesStmt:
+    pass
+
+
+@dataclass
+class DescribeStmt:
+    table: str
+
+
+@dataclass
+class TxStmt:
+    op: str  # begin | commit | rollback
+
+
+@dataclass
+class AnalyzeStmt:
+    table: str

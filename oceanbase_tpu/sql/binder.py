@@ -1,0 +1,1033 @@
+"""Binder: AST -> physical plan fragments + join graph.
+
+Combines the reference's resolver (src/sql/resolver — name/type binding),
+rewriter (src/sql/rewrite — subquery unnesting/decorrelation) and the
+front half of the optimizer (src/sql/optimizer — predicate classification
+into the join graph) in one pass.  The output QueryBlock is handed to the
+join-order optimizer (sql/optimizer.py) and code generator (sql/codegen.py).
+
+Subquery rewrites implemented (≙ ObTransformerImpl rules):
+- EXISTS / NOT EXISTS     -> semi / anti join (+ residual non-equality
+  correlated predicates, ≙ ob_transform_semi_to_inner / unnest)
+- x IN (subq)             -> semi join; NOT IN -> anti join
+- uncorrelated scalar     -> single-row fragment cross-joined in
+- correlated scalar agg   -> "magic set" decorrelation: inner agg grouped
+  by correlation keys joined back on them (≙ ob_transform_aggr_subquery)
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional
+
+from oceanbase_tpu.catalog import Catalog
+from oceanbase_tpu.datatypes import SqlType, TypeKind
+from oceanbase_tpu.exec.ops import AggSpec
+from oceanbase_tpu.exec import plan as pp
+from oceanbase_tpu.expr import ir
+from oceanbase_tpu.sql import ast
+from oceanbase_tpu.sql.parser import Interval
+
+
+class BindError(ValueError):
+    pass
+
+
+_uid = itertools.count()
+
+
+def fresh(prefix: str) -> str:
+    return f"{prefix}_{next(_uid)}"
+
+
+@dataclass
+class Scope:
+    """name -> column id visible to expressions.
+
+    entries: 'col' and 'alias.col' both map to the unique column id.
+    """
+
+    entries: dict[str, str] = field(default_factory=dict)
+    parent: Optional["Scope"] = None
+
+    def add(self, name: str, colid: str, alias: str | None = None):
+        if name in self.entries:
+            self.entries[name] = AMBIGUOUS
+        else:
+            self.entries[name] = colid
+        if alias:
+            self.entries[f"{alias}.{name}"] = colid
+
+    def lookup(self, name: str):
+        """-> (colid, depth) or (None, 0)."""
+        s, depth = self, 0
+        while s is not None:
+            cid = s.entries.get(name)
+            if cid is AMBIGUOUS:
+                raise BindError(f"ambiguous column {name!r}")
+            if cid is not None:
+                return cid, depth
+            s, depth = s.parent, depth + 1
+        return None, 0
+
+
+AMBIGUOUS = object()
+
+
+@dataclass
+class Fragment:
+    """One join-graph vertex: a physical subtree + its output columns.
+
+    ``colids`` is the authoritative ownership set (predicate/home checks);
+    ``cols`` maps *unqualified* visible names and can collide across
+    fragments, so it is never used for ownership."""
+
+    plan: pp.PlanNode
+    cols: dict[str, str]  # visible name -> colid (display/debug only)
+    est_rows: int
+    unique_cols: frozenset = frozenset()  # colids known unique (PK)
+    colids: frozenset = frozenset()       # every colid this subtree produces
+
+    def __post_init__(self):
+        if not self.colids:
+            self.colids = frozenset(self.cols.values())
+
+
+@dataclass
+class QueryBlock:
+    fragments: list = field(default_factory=list)
+    join_edges: list = field(default_factory=list)   # (fi, fj, lexpr, rexpr)
+    post_preds: list = field(default_factory=list)   # applied after joins
+    # set by finishing phases:
+    output: list = field(default_factory=list)       # [(colid, out_name)]
+    est_rows: int = 0
+
+
+class Binder:
+    def __init__(self, catalog: Catalog, ctes: dict | None = None,
+                 params: list | None = None):
+        self.catalog = catalog
+        self.ctes = dict(ctes or {})
+        self.params = params or []
+
+    # ------------------------------------------------------------------
+    def bind_select(self, stmt: ast.SelectStmt,
+                    outer: Scope | None = None) -> tuple[pp.PlanNode, list, int]:
+        """-> (plan, [(colid, name)], est_rows)."""
+        for name, sub in stmt.ctes:
+            self.ctes[name] = sub
+
+        plan, outputs, est = self._bind_core(stmt, outer)
+
+        for op, all_, rhs in stmt.setops:
+            # branches bind through bind_select so a branch's own
+            # ORDER BY / LIMIT (from a parenthesized select) stays inside it
+            rplan, routs, rest = self.bind_select(rhs, outer)
+            if len(routs) != len(outputs):
+                raise BindError("set operation column count mismatch")
+            plan, outputs, est = self._apply_setop(
+                op, all_, plan, outputs, est, rplan, routs, rest
+            )
+
+        if stmt.post_order_by:
+            keys, asc = [], []
+            for item in stmt.post_order_by:
+                e = item.expr
+                cid = self._output_ref(e, outputs)
+                if cid is None:
+                    raise BindError(
+                        "ORDER BY after a set operation must reference "
+                        "output columns")
+                keys.append(ir.col(cid))
+                asc.append(item.ascending)
+            plan = pp.Sort(plan, keys, asc)
+        if stmt.post_limit is not None:
+            plan = pp.Limit(plan, stmt.post_limit, stmt.post_offset)
+            est = min(est, stmt.post_limit)
+        return plan, outputs, est
+
+    @staticmethod
+    def _output_ref(e: ir.Expr, outputs) -> str | None:
+        """Resolve an ORDER BY item against the output list: ordinal or
+        output name/alias."""
+        if isinstance(e, ir.Literal) and isinstance(e.value, int):
+            k = e.value
+            if not 1 <= k <= len(outputs):
+                raise BindError(f"ORDER BY position {k} out of range")
+            return outputs[k - 1][0]
+        if isinstance(e, ir.ColumnRef):
+            base = e.name.split(".")[-1]
+            for cid, name in outputs:
+                if name == base:
+                    return cid
+        return None
+
+    # ------------------------------------------------------------------
+    def _bind_core(self, stmt: ast.SelectStmt, outer: Scope | None):
+        qb = QueryBlock()
+        scope = Scope(parent=outer)
+
+        # FROM
+        for tref in stmt.from_:
+            self._bind_table_expr(tref, qb, scope)
+        if not qb.fragments:
+            # SELECT without FROM: single-row dual
+            import numpy as np
+
+            if not self.catalog.has_table("__dual__"):
+                self.catalog.load_numpy("__dual__", {"one": np.array([1])})
+            qb.fragments.append(Fragment(
+                pp.TableScan("__dual__", columns=["one"],
+                             rename={"one": fresh("one")}),
+                {}, 1))
+
+        # WHERE: classify conjuncts
+        if stmt.where is not None:
+            self._bind_where(stmt.where, qb, scope)
+
+        # assemble join tree (order optimization + capacities in optimizer)
+        from oceanbase_tpu.sql.optimizer import build_join_tree
+
+        plan, est, colid_frag = build_join_tree(qb, self.catalog)
+
+        # residual predicates after joins
+        for pred in qb.post_preds:
+            plan = pp.Filter(plan, pred)
+            est = max(1, est // 3)
+
+        # SELECT list: expand stars, bind items
+        items: list[tuple[ir.Expr, str]] = []
+        for e, alias in stmt.items:
+            if isinstance(e, ast.Star):
+                for name, cid in scope.entries.items():
+                    if cid is AMBIGUOUS or "." in name:
+                        continue
+                    if e.table is not None and \
+                            scope.entries.get(f"{e.table}.{name}") != cid:
+                        continue
+                    items.append((ir.col(cid), name))
+                continue
+            bound = self.bind_expr(e, scope, allow_agg=True, qb_plan=[plan])
+            plan = self._maybe_updated_plan(plan)
+            items.append((bound, alias or self._auto_name(e)))
+
+        # aggregate detection
+        agg_calls: list[ir.AggCall] = []
+
+        def collect_aggs(x):
+            for node in ir.walk(x):
+                if isinstance(node, ir.AggCall):
+                    agg_calls.append(node)
+
+        for bound, _ in items:
+            collect_aggs(bound)
+        having_bound = None
+        if stmt.having is not None:
+            having_ast = self._fold_scalar_subqueries(stmt.having)
+            having_bound = self.bind_expr(having_ast, scope, allow_agg=True,
+                                          qb_plan=[plan])
+            plan = self._maybe_updated_plan(plan)
+            collect_aggs(having_bound)
+        is_agg = bool(stmt.group_by or agg_calls)
+        replace_fn = None
+        if is_agg:
+            plan, items, having_bound, est, replace_fn = self._bind_aggregate(
+                stmt, qb, scope, plan, items, having_bound, agg_calls, est,
+            )
+            if having_bound is not None:
+                plan = pp.Filter(plan, having_bound)
+                est = max(1, est // 3)
+        # project outputs to stable names
+        outputs = []
+        proj = {}
+        for bound, name in items:
+            cid = fresh("o")
+            proj[cid] = bound
+            outputs.append((cid, name))
+
+        # ORDER BY binds here: output alias/ordinal first, then arbitrary
+        # expressions (over the agg output when aggregated) as hidden
+        # projection columns
+        sort_keys, sort_asc = [], []
+        for item in stmt.order_by:
+            cid = self._output_ref(item.expr, outputs)
+            if cid is None:
+                b = self.bind_expr(item.expr, scope, allow_agg=is_agg)
+                if replace_fn is not None:
+                    b = replace_fn(b)
+                cid = fresh("h")
+                proj[cid] = b  # hidden: projected but not in outputs
+            sort_keys.append(ir.col(cid))
+            sort_asc.append(item.ascending)
+
+        plan = pp.Project(plan, proj)
+
+        if stmt.distinct:
+            if any(k.name not in {c for c, _ in outputs} for k in sort_keys):
+                raise BindError(
+                    "ORDER BY with DISTINCT must use select-list columns")
+            plan = pp.GroupBy(plan, {cid: ir.col(cid) for cid, _ in outputs},
+                              [], out_capacity=None)
+            est = max(1, est // 2)
+        if sort_keys:
+            plan = pp.Sort(plan, sort_keys, sort_asc)
+        if stmt.limit is not None:
+            plan = pp.Limit(plan, stmt.limit, stmt.offset)
+            est = min(est, stmt.limit)
+        return plan, outputs, est
+
+    def _fold_scalar_subqueries(self, e: ir.Expr) -> ir.Expr:
+        """Replace uncorrelated scalar subqueries with their value, computed
+        eagerly at bind time (plans are re-bound per execution, so this is a
+        constant for the statement — ≙ the reference's pre-calculated
+        "init plan" subqueries, onetime exprs in ObLogPlan).
+
+        Used where the subquery sits above an aggregation (HAVING), where
+        the cross-join rewrite would have to thread through the agg."""
+        if isinstance(e, ast.Subquery) and e.kind == "scalar":
+            plan, outs, _ = self.bind_select(e.select)
+            from oceanbase_tpu.exec.plan import execute_plan, referenced_tables
+
+            tables = {t: self.catalog.table_data(t)
+                      for t in referenced_tables(plan)}
+            rel = execute_plan(plan, tables)
+            from oceanbase_tpu.vector import to_numpy
+
+            raw = to_numpy(rel, limit=1)
+            cid = outs[0][0]
+            col = rel.columns[cid]
+            if len(raw[cid]) == 0 or (raw.get("__valid__" + cid) is not None
+                                      and not raw["__valid__" + cid][0]):
+                return ir.Literal(None)
+            v = raw[cid][0]
+            if col.dtype.kind == TypeKind.DECIMAL:
+                return ir.Literal(int(v), col.dtype)
+            if col.dtype.kind == TypeKind.STRING:
+                return ir.Literal(str(v))
+            if col.dtype.kind in (TypeKind.FLOAT, TypeKind.DOUBLE):
+                return ir.Literal(float(v))
+            return ir.Literal(int(v), col.dtype)
+        return _map_children(e, self._fold_scalar_subqueries)
+
+    def _maybe_updated_plan(self, plan):
+        # scalar-subquery binding can wrap the plan (cross join); the
+        # updated plan is left in self._plan_override by bind_expr
+        ov = getattr(self, "_plan_override", None)
+        self._plan_override = None
+        return ov if ov is not None else plan
+
+    @staticmethod
+    def _auto_name(e: ir.Expr) -> str:
+        if isinstance(e, ir.ColumnRef):
+            return e.name.split(".")[-1]
+        return fresh("expr")
+
+    # ------------------------------------------------------------------
+    def _bind_table_expr(self, tref, qb: QueryBlock, scope: Scope):
+        if isinstance(tref, ast.TableRef):
+            self._bind_base_table(tref, qb, scope)
+        elif isinstance(tref, ast.SubqueryRef):
+            sub_plan, sub_outs, sub_est = self.bind_select(tref.select,
+                                                           outer=None)
+            cols = {}
+            for cid, name in sub_outs:
+                scope.add(name, cid, alias=tref.alias)
+                cols[name] = cid
+            qb.fragments.append(Fragment(sub_plan, cols, max(sub_est, 1)))
+        elif isinstance(tref, ast.JoinRef):
+            self._bind_join(tref, qb, scope)
+        else:  # pragma: no cover
+            raise BindError(f"unsupported FROM item {tref}")
+
+    def _bind_base_table(self, tref: ast.TableRef, qb, scope):
+        name = tref.name
+        if name in self.ctes:
+            sub = self.ctes[name]
+            sub_plan, sub_outs, sub_est = self.bind_select(sub, outer=None)
+            cols = {}
+            for cid, oname in sub_outs:
+                scope.add(oname, cid, alias=tref.alias or name)
+                cols[oname] = cid
+            qb.fragments.append(Fragment(sub_plan, cols, max(sub_est, 1)))
+            return
+        tdef = self.catalog.table_def(name)
+        alias = tref.alias or name
+        rename = {}
+        cols = {}
+        unique = []
+        for c in tdef.columns:
+            cid = fresh(f"{alias}_{c.name}")
+            rename[c.name] = cid
+            scope.add(c.name, cid, alias=alias)
+            cols[c.name] = cid
+        if len(tdef.primary_key) == 1:
+            unique.append(rename[tdef.primary_key[0]])
+        qb.fragments.append(Fragment(
+            pp.TableScan(name, rename=rename),
+            cols, max(tdef.row_count, 1), frozenset(unique),
+        ))
+
+    def _bind_join(self, j: ast.JoinRef, qb: QueryBlock, scope: Scope):
+        if j.kind in ("inner", "cross"):
+            # inner joins melt into the join graph
+            self._bind_table_expr(j.left, qb, scope)
+            self._bind_table_expr(j.right, qb, scope)
+            if j.on is not None:
+                on = self._expand_using(j.on, scope)
+                self._bind_where(on, qb, scope)
+            return
+        if j.kind == "right":
+            j = ast.JoinRef(j.right, j.left, "left", j.on)
+        # LEFT join binds eagerly.  Each side binds into its OWN QueryBlock
+        # so inner-join edges inside a side stay locally indexed, then the
+        # side collapses to one fragment via the join-tree builder.
+        lf = self._bind_side(j.left, scope)
+        rf = self._bind_side(j.right, scope)
+        on = self._expand_using(j.on, scope)
+        eqs, lpreds, rpreds, residual = self._split_on(on, lf, rf, scope)
+        for p in rpreds:
+            rf = Fragment(pp.Filter(rf.plan, p), rf.cols,
+                          max(1, rf.est_rows // 3), rf.unique_cols)
+        lkeys = [e[0] for e in eqs]
+        rkeys = [e[1] for e in eqs]
+        cap = _pow2(int(lf.est_rows * 1.5) + 16)
+        plan = pp.HashJoin(lf.plan, rf.plan, lkeys, rkeys, how="left",
+                           out_capacity=cap)
+        for p in lpreds + residual:
+            # ON predicates on the left side of a LEFT JOIN semantically
+            # only nullify matches; approximate by post-filtering matched
+            # rows is wrong, so keep as residual on the join output for
+            # matched rows only — round-1: treat as join residual filter
+            plan = pp.Filter(plan, p)
+        merged_cols = {**lf.cols, **rf.cols}
+        qb.fragments.append(Fragment(plan, merged_cols, lf.est_rows,
+                                     lf.unique_cols,
+                                     colids=lf.colids | rf.colids))
+
+    def _bind_side(self, tref, scope: Scope) -> Fragment:
+        """Bind one side of an eager (outer) join into a single fragment."""
+        sub_qb = QueryBlock()
+        self._bind_table_expr(tref, sub_qb, scope)
+        if len(sub_qb.fragments) == 1 and not sub_qb.post_preds:
+            return sub_qb.fragments[0]
+        from oceanbase_tpu.sql.optimizer import build_join_tree
+
+        plan, est, _ = build_join_tree(sub_qb, self.catalog)
+        for pred in sub_qb.post_preds:
+            plan = pp.Filter(plan, pred)
+            est = max(1, est // 3)
+        cols = {}
+        colids = frozenset()
+        unique = frozenset()
+        for f in sub_qb.fragments:
+            cols.update(f.cols)
+            colids |= f.colids
+            unique |= f.unique_cols
+        return Fragment(plan, cols, est, unique, colids=colids)
+
+    def _expand_using(self, on, scope):
+        if isinstance(on, tuple) and on and on[0] == "using":
+            conj = None
+            for c in on[1]:
+                p = ir.Cmp("=", ir.ColumnRef(c), ir.ColumnRef(c))
+                raise BindError("USING requires distinct qualifiers; use ON")
+            return conj
+        return on
+
+    def _split_on(self, on, lf: Fragment, rf: Fragment, scope: Scope):
+        """Split a bound ON condition into equi keys / side preds / residual."""
+        eqs, lpreds, rpreds, residual = [], [], [], []
+        if on is None:
+            return eqs, lpreds, rpreds, residual
+        lcols = set(lf.colids)
+        rcols = set(rf.colids)
+        for conj in _conjuncts(on):
+            b = self.bind_expr(conj, scope)
+            used = {n.name for n in ir.walk(b) if isinstance(n, ir.ColumnRef)}
+            if isinstance(b, ir.Cmp) and b.op == "=":
+                lu = {n.name for n in ir.walk(b.left)
+                      if isinstance(n, ir.ColumnRef)}
+                ru = {n.name for n in ir.walk(b.right)
+                      if isinstance(n, ir.ColumnRef)}
+                if lu <= lcols and ru <= rcols:
+                    eqs.append((b.left, b.right))
+                    continue
+                if lu <= rcols and ru <= lcols:
+                    eqs.append((b.right, b.left))
+                    continue
+            if used <= lcols:
+                lpreds.append(b)
+            elif used <= rcols:
+                rpreds.append(b)
+            else:
+                residual.append(b)
+        return eqs, lpreds, rpreds, residual
+
+    # ------------------------------------------------------------------
+    def _bind_where(self, where: ir.Expr, qb: QueryBlock, scope: Scope):
+        for conj in _conjuncts(where):
+            self._bind_conjunct(conj, qb, scope)
+
+    def _bind_conjunct(self, conj, qb: QueryBlock, scope: Scope):
+        # subquery predicates get rewritten structurally
+        sub = _find_subquery(conj)
+        if sub is not None:
+            self._rewrite_subquery_pred(conj, sub, qb, scope)
+            return
+        bound = self.bind_expr(conj, scope)
+        used = {n.name for n in ir.walk(bound) if isinstance(n, ir.ColumnRef)}
+        homes = [i for i, f in enumerate(qb.fragments)
+                 if used & f.colids]
+        if isinstance(bound, ir.Cmp) and bound.op == "=" and len(homes) == 2:
+            lu = {n.name for n in ir.walk(bound.left)
+                  if isinstance(n, ir.ColumnRef)}
+            ru = {n.name for n in ir.walk(bound.right)
+                  if isinstance(n, ir.ColumnRef)}
+            fi, fj = homes
+            ci = set(qb.fragments[fi].colids)
+            if lu <= ci and ru.isdisjoint(ci):
+                qb.join_edges.append((fi, fj, bound.left, bound.right))
+                return
+            if ru <= ci and lu.isdisjoint(ci):
+                qb.join_edges.append((fj, fi, bound.left, bound.right))
+                return
+        if len(homes) <= 1:
+            if homes:
+                i = homes[0]
+                f = qb.fragments[i]
+                qb.fragments[i] = Fragment(
+                    pp.Filter(f.plan, bound), f.cols,
+                    max(1, int(f.est_rows * _selectivity(bound))),
+                    f.unique_cols,
+                )
+            else:
+                qb.post_preds.append(bound)  # constant predicate
+            return
+        qb.post_preds.append(bound)
+
+    # ------------------------------------------------------------------
+    # subquery rewrites
+    # ------------------------------------------------------------------
+    def _rewrite_subquery_pred(self, conj, sub: ast.Subquery, qb, scope):
+        if sub.kind == "exists" or (sub.kind in ("in", "quant")):
+            if conj is sub:
+                return self._rewrite_semi(sub, qb, scope,
+                                          anti=sub.negated)
+            if isinstance(conj, ir.Not) and conj.arg is sub:
+                return self._rewrite_semi(sub, qb, scope,
+                                          anti=not sub.negated)
+        # comparison against scalar subquery
+        if isinstance(conj, ir.Cmp):
+            # sub_on_left: (subq) op other -> val op other
+            #  otherwise:  other op (subq) -> other op val
+            for side, other, sub_on_left in ((conj.left, conj.right, True),
+                                             (conj.right, conj.left, False)):
+                if isinstance(side, ast.Subquery) and side.kind == "scalar":
+                    return self._rewrite_scalar_cmp(conj, side, other,
+                                                    sub_on_left, qb, scope)
+        raise BindError(f"unsupported subquery predicate {type(conj).__name__}")
+
+    def _rewrite_semi(self, sub: ast.Subquery, qb, scope, anti: bool):
+        """EXISTS / IN / quantified -> semi or anti join on the home fragment."""
+        inner = sub.select
+        corr = _CorrelationCollector(self, scope)
+        in_plan, eq_outer, eq_inner_cids, residual, in_outs, in_est = \
+            corr.bind_inner(inner)
+
+        lhs_exprs = []
+        rhs_cids = []
+        if sub.kind in ("in", "quant"):
+            lhs = self.bind_expr(sub.lhs, scope)
+            lhs_exprs.append(lhs)
+            rhs_cids.append(in_outs[0][0])
+        lhs_exprs += eq_outer
+        rhs_cids += eq_inner_cids
+
+        if not lhs_exprs and not residual:
+            raise BindError("EXISTS without correlation unsupported (round 1)")
+
+        used = set()
+        for e in lhs_exprs:
+            used |= {n.name for n in ir.walk(e) if isinstance(n, ir.ColumnRef)}
+        for e in residual:
+            used |= {n.name for n in ir.walk(e) if isinstance(n, ir.ColumnRef)}
+        homes = [i for i, f in enumerate(qb.fragments)
+                 if used & f.colids]
+        if len(homes) != 1:
+            raise BindError("correlated subquery spans multiple tables "
+                            "(unsupported in round 1)")
+        i = homes[0]
+        f = qb.fragments[i]
+        how = "anti" if anti else "semi"
+        cap = _pow2(int(f.est_rows * 2) + 16)
+        rkeys = [ir.col(c) for c in rhs_cids]
+        if residual:
+            new_plan = pp.SemiJoinResidual(
+                f.plan, in_plan, lhs_exprs, rkeys, residual,
+                anti=anti, out_capacity=cap,
+            )
+        else:
+            new_plan = pp.HashJoin(f.plan, in_plan, lhs_exprs, rkeys,
+                                   how=how, out_capacity=None)
+        est = max(1, f.est_rows // (2 if not anti else 3))
+        qb.fragments[i] = Fragment(new_plan, f.cols, est, f.unique_cols)
+
+    def _rewrite_scalar_cmp(self, conj, sub, other_side, sub_on_left, qb,
+                            scope):
+        inner = sub.select
+        corr = _CorrelationCollector(self, scope)
+        in_plan, eq_outer, eq_inner_cids, residual, in_outs, in_est = \
+            corr.bind_inner(inner)
+        if residual:
+            raise BindError("non-equality correlation in scalar subquery")
+        val_cid = in_outs[0][0]
+        if not eq_outer:
+            # uncorrelated: single-row fragment cross-joined into the block
+            frag = Fragment(in_plan, {}, 1)
+            qb.fragments.append(frag)
+        else:
+            frag = Fragment(in_plan, {}, max(in_est, 1))
+            qb.fragments.append(frag)
+            j = len(qb.fragments) - 1
+            for oexpr, icid in zip(eq_outer, eq_inner_cids):
+                used = {n.name for n in ir.walk(oexpr)
+                        if isinstance(n, ir.ColumnRef)}
+                homes = [i for i, f in enumerate(qb.fragments[:-1])
+                         if used & f.colids]
+                if len(homes) != 1:
+                    raise BindError("correlation spans fragments")
+                qb.join_edges.append((homes[0], j, oexpr, ir.col(icid)))
+        other_bound = self.bind_expr(other_side, scope)
+        lhs, rhs = (ir.col(val_cid), other_bound) if sub_on_left else \
+            (other_bound, ir.col(val_cid))
+        qb.post_preds.append(ir.Cmp(conj.op, lhs, rhs))
+
+    # ------------------------------------------------------------------
+    # aggregation
+    # ------------------------------------------------------------------
+    def _bind_aggregate(self, stmt, qb, scope, plan, items, having_bound,
+                        agg_calls, est):
+        # group keys
+        key_map: dict[str, ir.Expr] = {}
+        key_repr: dict[str, str] = {}
+        alias_map = {name: bound for bound, name in items}
+        for g in stmt.group_by:
+            try:
+                b = self.bind_expr(g, scope)
+            except BindError:
+                if isinstance(g, ir.ColumnRef) and g.name in alias_map:
+                    b = alias_map[g.name]
+                else:
+                    raise
+            cid = fresh("g")
+            key_map[cid] = b
+            key_repr[_erepr(b)] = cid
+
+        # aggregate specs (dedup by structure)
+        agg_specs: list[AggSpec] = []
+        agg_ids: dict[str, str] = {}
+
+        def agg_cid(a: ir.AggCall) -> str:
+            k = f"{a.fn}|{_erepr(a.arg) if a.arg is not None else ''}"
+            if k not in agg_ids:
+                cid = fresh("a")
+                agg_ids[k] = cid
+                agg_specs.append(AggSpec(cid, a.fn, a.arg))
+            return agg_ids[k]
+
+        def replace(e: ir.Expr) -> ir.Expr:
+            if isinstance(e, ir.AggCall):
+                return ir.col(agg_cid(e))
+            r = key_repr.get(_erepr(e))
+            if r is not None:
+                return ir.col(r)
+            return _map_children(e, replace)
+
+        new_items = [(replace(b), name) for b, name in items]
+        if having_bound is not None:
+            having_bound = replace(having_bound)
+
+        n_keys_est = 1
+        for b in key_map.values():
+            n_keys_est *= 32
+        out_cap = _pow2(min(est, max(64, min(n_keys_est, est))))
+        if key_map:
+            plan = pp.GroupBy(plan, key_map, agg_specs, out_capacity=out_cap)
+            est = min(est, out_cap)
+        else:
+            plan = pp.ScalarAgg(plan, agg_specs)
+            est = 1
+        return plan, new_items, having_bound, est, replace
+
+    # ------------------------------------------------------------------
+    # expression binding
+    # ------------------------------------------------------------------
+    def bind_expr(self, e: ir.Expr, scope: Scope, allow_agg=False,
+                  qb_plan=None) -> ir.Expr:
+        if isinstance(e, ir.ColumnRef):
+            cid, depth = scope.lookup(e.name)
+            if cid is None:
+                raise BindError(f"unknown column {e.name!r}")
+            return ir.col(cid)
+        if isinstance(e, ast.Param):
+            if e.index >= len(self.params):
+                raise BindError(f"missing parameter {e.index}")
+            return ir.Literal(self.params[e.index])
+        if isinstance(e, ast.Subquery):
+            raise BindError("subquery only supported in WHERE/HAVING "
+                            "comparisons (round 1)")
+        if isinstance(e, Interval):
+            raise BindError("INTERVAL outside date arithmetic")
+        if isinstance(e, ir.FuncCall) and e.name in ("date_add", "date_sub"):
+            base = self.bind_expr(e.args[0], scope, allow_agg)
+            n = e.args[1].value
+            unit = e.args[2].value
+            return _fold_date_arith(e.name, base, n, unit)
+        if isinstance(e, ir.AggCall):
+            if not allow_agg:
+                raise BindError("aggregate not allowed here")
+            arg = self.bind_expr(e.arg, scope) if e.arg is not None else None
+            return ir.AggCall(e.fn, arg, e.distinct)
+        return _map_children(
+            e, lambda c: self.bind_expr(c, scope, allow_agg, qb_plan)
+        )
+
+    # ------------------------------------------------------------------
+    def _apply_setop(self, op, all_, plan, outputs, est, rplan, routs, rest):
+        # align rhs output names to lhs colids positionally
+        proj = {}
+        for (lcid, _), (rcid, _) in zip(outputs, routs):
+            proj[lcid] = ir.col(rcid)
+        rplan = pp.Project(rplan, proj)
+        if op == "union":
+            plan = pp.Union([plan, rplan])
+            est = est + rest
+            if not all_:
+                plan = pp.GroupBy(plan,
+                                  {cid: ir.col(cid) for cid, _ in outputs},
+                                  [], out_capacity=None)
+        elif op == "intersect":
+            plan = pp.GroupBy(plan, {cid: ir.col(cid) for cid, _ in outputs},
+                              [], out_capacity=None)
+            plan = pp.HashJoin(plan, rplan,
+                               [ir.col(c) for c, _ in outputs],
+                               [ir.col(c) for c, _ in outputs], how="semi")
+        elif op == "except":
+            plan = pp.GroupBy(plan, {cid: ir.col(cid) for cid, _ in outputs},
+                              [], out_capacity=None)
+            plan = pp.HashJoin(plan, rplan,
+                               [ir.col(c) for c, _ in outputs],
+                               [ir.col(c) for c, _ in outputs], how="anti")
+        return plan, outputs, est
+
+
+class _CorrelationCollector:
+    """Bind an inner (sub)query, splitting out correlated equality
+    predicates; for aggregate subqueries, decorrelate by grouping on the
+    inner correlation columns (magic-set rewrite)."""
+
+    def __init__(self, binder: Binder, outer_scope: Scope):
+        self.binder = binder
+        self.outer = outer_scope
+
+    def bind_inner(self, inner: ast.SelectStmt):
+        b = self.binder
+        qb = QueryBlock()
+        scope = Scope(parent=self.outer)
+        for name, sub in inner.ctes:
+            b.ctes[name] = sub
+        for tref in inner.from_:
+            b._bind_table_expr(tref, qb, scope)
+        inner_cols = set()
+        for f in qb.fragments:
+            inner_cols |= f.colids
+
+        eq_outer: list[ir.Expr] = []
+        eq_inner: list[ir.Expr] = []
+        residual: list[ir.Expr] = []
+        if inner.where is not None:
+            for conj in _conjuncts(inner.where):
+                sub = _find_subquery(conj)
+                if sub is not None:
+                    b._rewrite_subquery_pred(conj, sub, qb, scope)
+                    continue
+                bound = b.bind_expr(conj, scope)
+                used = {n.name for n in ir.walk(bound)
+                        if isinstance(n, ir.ColumnRef)}
+                outer_used = used - inner_cols
+                if not outer_used:
+                    b._bind_conjunct_bound(bound, qb)
+                    continue
+                if isinstance(bound, ir.Cmp) and bound.op == "=":
+                    lu = {n.name for n in ir.walk(bound.left)
+                          if isinstance(n, ir.ColumnRef)}
+                    ru = {n.name for n in ir.walk(bound.right)
+                          if isinstance(n, ir.ColumnRef)}
+                    if lu and lu <= inner_cols and ru.isdisjoint(inner_cols):
+                        eq_inner.append(bound.left)
+                        eq_outer.append(bound.right)
+                        continue
+                    if ru and ru <= inner_cols and lu.isdisjoint(inner_cols):
+                        eq_inner.append(bound.right)
+                        eq_outer.append(bound.left)
+                        continue
+                residual.append(bound)
+
+        from oceanbase_tpu.sql.optimizer import build_join_tree
+
+        plan, est, _ = build_join_tree(qb, b.catalog)
+
+        # bind select items (inner scope)
+        items = []
+        agg_found = False
+        for e, alias in inner.items:
+            if isinstance(e, ast.Star):
+                items.append((ir.lit(1), alias or "one"))
+                continue
+            bound = b.bind_expr(e, scope, allow_agg=True)
+            if any(isinstance(nn, ir.AggCall) for nn in ir.walk(bound)):
+                agg_found = True
+            items.append((bound, alias or b._auto_name(e)))
+
+        eq_inner_cids = []
+        if agg_found or inner.group_by:
+            # decorrelated aggregate: group by correlation cols + explicit
+            key_map = {}
+            for ie in eq_inner:
+                cid = fresh("ck")
+                key_map[cid] = ie
+                eq_inner_cids.append(cid)
+            for g in inner.group_by:
+                cid = fresh("g")
+                key_map[cid] = b.bind_expr(g, scope)
+                # IN-subqueries select their group key; map via repr below
+            agg_specs = []
+            agg_ids = {}
+
+            def agg_cid(a: ir.AggCall) -> str:
+                k = f"{a.fn}|{_erepr(a.arg) if a.arg is not None else ''}"
+                if k not in agg_ids:
+                    cid = fresh("a")
+                    agg_ids[k] = cid
+                    agg_specs.append(AggSpec(cid, a.fn, a.arg))
+                return agg_ids[k]
+
+            key_repr = {_erepr(kexpr): kcid for kcid, kexpr in key_map.items()}
+
+            def replace(x):
+                if isinstance(x, ir.AggCall):
+                    return ir.col(agg_cid(x))
+                r = key_repr.get(_erepr(x))
+                if r is not None:
+                    return ir.col(r)
+                return _map_children(x, replace)
+
+            new_items = [(replace(bound), name) for bound, name in items]
+            if key_map:
+                cap = _pow2(max(64, min(est, 1 << 22)))
+                plan = pp.GroupBy(plan, key_map, agg_specs, out_capacity=cap)
+                est = min(est, cap)
+            else:
+                plan = pp.ScalarAgg(plan, agg_specs)
+                est = 1
+            if inner.having is not None:
+                hb = replace(b.bind_expr(inner.having, scope, allow_agg=True))
+                plan = pp.Filter(plan, hb)
+            # project the select outputs
+            outs = []
+            proj = {c: ir.col(c) for c in eq_inner_cids}
+            for bound, name in new_items:
+                cid = fresh("so")
+                proj[cid] = bound
+                outs.append((cid, name))
+            plan = pp.Project(plan, proj)
+            return plan, eq_outer, eq_inner_cids, residual, outs, est
+
+        # non-aggregate subquery (EXISTS / IN): project value + join cols
+        outs = []
+        proj = {}
+        for bound, name in items:
+            cid = fresh("so")
+            proj[cid] = bound
+            outs.append((cid, name))
+        for ie in eq_inner:
+            cid = fresh("ck")
+            proj[cid] = ie
+            eq_inner_cids.append(cid)
+        # residual predicates reference inner cols directly: keep them
+        # visible through the projection
+        for r in residual:
+            for nn in ir.walk(r):
+                if isinstance(nn, ir.ColumnRef) and nn.name in inner_cols:
+                    proj.setdefault(nn.name, ir.col(nn.name))
+        plan = pp.Project(plan, proj)
+        return plan, eq_outer, eq_inner_cids, residual, outs, est
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _conjuncts(e: ir.Expr):
+    if isinstance(e, ir.Logic) and e.op == "and":
+        for a in e.args:
+            yield from _conjuncts(a)
+    else:
+        yield e
+
+
+def _find_subquery(e: ir.Expr):
+    if isinstance(e, ast.Subquery):
+        return e
+    for c in e.children():
+        s = _find_subquery(c)
+        if s is not None:
+            return s
+    if isinstance(e, ir.Not):
+        return _find_subquery(e.arg)
+    if isinstance(e, ir.Cmp):
+        for side in (e.left, e.right):
+            if isinstance(side, ast.Subquery):
+                return side
+    return None
+
+
+def _map_children(e: ir.Expr, fn):
+    """Rebuild an expression node with fn applied to child expressions."""
+    if isinstance(e, ir.Literal) or isinstance(e, ir.ColumnRef):
+        return e
+    if isinstance(e, ir.Arith):
+        return ir.Arith(e.op, fn(e.left), fn(e.right))
+    if isinstance(e, ir.Cmp):
+        return ir.Cmp(e.op, fn(e.left), fn(e.right))
+    if isinstance(e, ir.Logic):
+        return ir.Logic(e.op, [fn(a) for a in e.args])
+    if isinstance(e, ir.Not):
+        return ir.Not(fn(e.arg))
+    if isinstance(e, ir.InList):
+        return ir.InList(fn(e.arg), e.values, e.negated)
+    if isinstance(e, ir.Like):
+        return ir.Like(fn(e.arg), e.pattern, e.negated)
+    if isinstance(e, ir.IsNull):
+        return ir.IsNull(fn(e.arg), e.negated)
+    if isinstance(e, ir.Case):
+        return ir.Case([(fn(c), fn(v)) for c, v in e.whens],
+                       fn(e.else_) if e.else_ is not None else None)
+    if isinstance(e, ir.Cast):
+        return ir.Cast(fn(e.arg), e.dtype)
+    if isinstance(e, ir.FuncCall):
+        return ir.FuncCall(e.name, [fn(a) for a in e.args])
+    if isinstance(e, ir.AggCall):
+        return ir.AggCall(e.fn, fn(e.arg) if e.arg is not None else None,
+                          e.distinct)
+    return e
+
+
+def _erepr(e) -> str:
+    if e is None:
+        return ""
+    if isinstance(e, ir.ColumnRef):
+        return f"C({e.name})"
+    if isinstance(e, ir.Literal):
+        return f"L({e.value!r},{e.dtype})"
+    parts = [type(e).__name__]
+    for f_ in vars(e).values():
+        if isinstance(f_, ir.Expr):
+            parts.append(_erepr(f_))
+        elif isinstance(f_, list):
+            for x in f_:
+                if isinstance(x, ir.Expr):
+                    parts.append(_erepr(x))
+                elif isinstance(x, tuple):
+                    parts.append(",".join(_erepr(y) for y in x
+                                          if isinstance(y, ir.Expr)))
+                else:
+                    parts.append(repr(x))
+        else:
+            parts.append(repr(f_))
+    return "(" + "|".join(parts) + ")"
+
+
+def _selectivity(pred: ir.Expr) -> float:
+    if isinstance(pred, ir.Cmp):
+        return 0.1 if pred.op == "=" else 0.4
+    if isinstance(pred, ir.InList):
+        return min(0.9, 0.1 * max(len(pred.values), 1))
+    if isinstance(pred, ir.Like):
+        return 0.1
+    if isinstance(pred, ir.Logic):
+        s = 1.0
+        if pred.op == "and":
+            for a in pred.args:
+                s *= _selectivity(a)
+        else:
+            s = min(1.0, sum(_selectivity(a) for a in pred.args))
+        return s
+    return 0.5
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _fold_date_arith(fn: str, base: ir.Expr, n: int, unit: str) -> ir.Expr:
+    sign = 1 if fn == "date_add" else -1
+    if isinstance(base, ir.Literal) and base.dtype is not None and \
+            base.dtype.kind == TypeKind.DATE:
+        import numpy as np
+
+        from oceanbase_tpu.datatypes import DATE_EPOCH, date_to_days
+
+        d = np.datetime64(base.value, "D")
+        if unit == "day":
+            d2 = d + np.timedelta64(sign * n, "D")
+        elif unit == "month":
+            m = d.astype("datetime64[M]") + np.timedelta64(sign * n, "M")
+            day = (d - d.astype("datetime64[M]")).astype(int)
+            d2 = m.astype("datetime64[D]") + np.timedelta64(int(day), "D")
+        elif unit == "year":
+            y = d.astype("datetime64[Y]") + np.timedelta64(sign * n, "Y")
+            rest = (d - d.astype("datetime64[Y]").astype("datetime64[D]"))
+            d2 = y.astype("datetime64[D]") + rest
+        else:
+            raise BindError(f"unsupported interval unit {unit}")
+        return ir.Literal(str(d2), SqlType.date())
+    if unit == "day":
+        return ir.Arith("+" if sign > 0 else "-", base, ir.lit(n))
+    return ir.FuncCall("add_months", [base, ir.lit(sign * n)])
+
+
+# late-bound helper used by _CorrelationCollector
+def _bind_conjunct_bound(self: Binder, bound: ir.Expr, qb: QueryBlock):
+    used = {n.name for n in ir.walk(bound) if isinstance(n, ir.ColumnRef)}
+    homes = [i for i, f in enumerate(qb.fragments)
+             if used & f.colids]
+    if isinstance(bound, ir.Cmp) and bound.op == "=" and len(homes) == 2:
+        lu = {n.name for n in ir.walk(bound.left)
+              if isinstance(n, ir.ColumnRef)}
+        fi, fj = homes
+        ci = set(qb.fragments[fi].colids)
+        ru = {n.name for n in ir.walk(bound.right)
+              if isinstance(n, ir.ColumnRef)}
+        if lu <= ci and ru.isdisjoint(ci):
+            qb.join_edges.append((fi, fj, bound.left, bound.right))
+            return
+        if ru <= ci and lu.isdisjoint(ci):
+            qb.join_edges.append((fj, fi, bound.left, bound.right))
+            return
+    if len(homes) == 1:
+        i = homes[0]
+        f = qb.fragments[i]
+        qb.fragments[i] = Fragment(
+            pp.Filter(f.plan, bound), f.cols,
+            max(1, int(f.est_rows * _selectivity(bound))), f.unique_cols,
+        )
+    else:
+        qb.post_preds.append(bound)
+
+
+Binder._bind_conjunct_bound = _bind_conjunct_bound

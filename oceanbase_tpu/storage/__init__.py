@@ -1,0 +1,28 @@
+"""Storage engine: LSM-lite column store + MVCC memtable.
+
+Reference analog (SURVEY §2.5/§2.6, src/storage ~1M LoC):
+- encoded immutable segments ≙ SSTable macro/micro blocks with cs_encoding
+  (src/storage/blocksstable, src/storage/column_store)
+- zone maps / block skipping ≙ index-block aggregates + blockscan pushdown
+  (src/storage/access/ob_vector_store.cpp:292 fast path)
+- memtable with MVCC version chains ≙ ObMemtable (src/storage/memtable/
+  ob_memtable.cpp:542 set / mvcc_write_)
+- freeze -> mini / minor / major compaction ≙ ObTenantTabletScheduler DAGs
+  (src/storage/compaction/ob_tenant_tablet_scheduler.h:140)
+- manifest + checkpoint ≙ slog / slog_ckpt (src/storage/slog)
+
+TPU-first split: the engine keeps encoded columns + metadata on the host,
+decodes straight into device Relations (the executor's scan source), and
+serves snapshot reads by stacking [base segments ; memtable delta] with a
+validity mask — the "LSM merge" is a device concat + anti-join on updated
+keys rather than a row-at-a-time fuse.
+"""
+
+from oceanbase_tpu.storage.encoding import (
+    EncodedColumn,
+    ZoneMap,
+    decode_column,
+    encode_column,
+)
+
+__all__ = ["EncodedColumn", "ZoneMap", "encode_column", "decode_column"]

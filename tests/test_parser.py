@@ -1,0 +1,91 @@
+"""Parser unit tests (≙ unittest/sql/parser)."""
+
+import pytest
+
+from oceanbase_tpu.bench.tpch_queries import QUERIES
+from oceanbase_tpu.expr import ir
+from oceanbase_tpu.sql import ast
+from oceanbase_tpu.sql.parser import parse_sql
+
+
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_parse_all_tpch(qnum):
+    stmt = parse_sql(QUERIES[qnum])
+    assert isinstance(stmt, ast.SelectStmt)
+    assert stmt.items
+
+
+def test_basic_select():
+    s = parse_sql("select a, b + 1 as c from t where a > 5 and b in (1,2,3) "
+                  "group by a order by c desc limit 10 offset 2")
+    assert len(s.items) == 2
+    assert s.items[1][1] == "c"
+    assert isinstance(s.where, ir.Logic)
+    assert s.limit == 10 and s.offset == 2
+    assert not s.order_by[0].ascending
+
+
+def test_joins_and_aliases():
+    s = parse_sql("select * from a join b on a.x = b.y "
+                  "left join c as cc on b.z = cc.w, d")
+    assert len(s.from_) == 2
+    j = s.from_[0]
+    assert isinstance(j, ast.JoinRef) and j.kind == "left"
+    assert isinstance(j.left, ast.JoinRef) and j.left.kind == "inner"
+
+
+def test_subqueries():
+    s = parse_sql("select a from t where exists (select 1 from u where u.x = t.a) "
+                  "and a in (select b from v) "
+                  "and a > (select avg(b) from w)")
+    conj = s.where
+    assert isinstance(conj, ir.Logic)
+
+
+def test_case_cast_extract():
+    s = parse_sql("select case when a > 0 then 'p' else 'n' end, "
+                  "cast(a as decimal(10,2)), extract(year from d) from t")
+    assert isinstance(s.items[0][0], ir.Case)
+    assert isinstance(s.items[1][0], ir.Cast)
+    assert isinstance(s.items[2][0], ir.FuncCall)
+
+
+def test_ddl_dml():
+    c = parse_sql("create table t (a int primary key, b varchar(10) not null, "
+                  "c decimal(15,2), d date)")
+    assert isinstance(c, ast.CreateTableStmt)
+    assert c.primary_key == ["a"]
+    assert len(c.columns) == 4
+
+    i = parse_sql("insert into t (a, b) values (1, 'x'), (2, 'y')")
+    assert isinstance(i, ast.InsertStmt) and len(i.rows) == 2
+
+    u = parse_sql("update t set b = 'z', c = c + 1 where a = 1")
+    assert isinstance(u, ast.UpdateStmt) and len(u.assignments) == 2
+
+    d = parse_sql("delete from t where a < 5")
+    assert isinstance(d, ast.DeleteStmt)
+
+    x = parse_sql("drop table if exists t")
+    assert isinstance(x, ast.DropTableStmt) and x.if_exists
+
+
+def test_setops_and_ctes():
+    s = parse_sql("with x as (select a from t) "
+                  "select a from x union all select b from u order by 1")
+    assert len(s.ctes) == 1
+    assert len(s.setops) == 1 and s.setops[0][0] == "union" and s.setops[0][1]
+
+
+def test_interval_folding():
+    s = parse_sql("select 1 from t where d < date '1994-01-01' + interval '1' year")
+    cmp = s.where
+    assert isinstance(cmp.right, ir.FuncCall) and cmp.right.name == "date_add"
+
+
+def test_params():
+    from oceanbase_tpu.sql.parser import Parser
+
+    p = Parser("select a from t where b = ? and c > ?")
+    p.parse()
+    assert p.n_params == 2
