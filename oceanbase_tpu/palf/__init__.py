@@ -1,0 +1,21 @@
+"""PALF — a Paxos-family replicated log (host control plane).
+
+Reference analog: src/logservice/palf (57k LoC): PalfHandleImpl
+(submit_log palf_handle_impl.cpp:406, receive_log :3235), the sliding
+window group-buffering (log_sliding_window.cpp), lease-based election
+(election/algorithm/election_impl.h:43) and follower replay
+(replayservice).
+
+The TPU build keeps replication on the host by design (SURVEY north star).
+This package implements a leader-based majority-ack replicated log with:
+- terms + lease election with randomized timeouts (election.py)
+- group commit: appends batch into group buffers before fsync (log.py)
+- an in-process multi-replica cluster harness over queues — the analog of
+  mittest/palf_cluster (SURVEY §4 tier 3) — plus on-disk log files with
+  crash recovery.
+"""
+
+from oceanbase_tpu.palf.log import LogEntry, PalfReplica
+from oceanbase_tpu.palf.cluster import PalfCluster
+
+__all__ = ["LogEntry", "PalfReplica", "PalfCluster"]

@@ -1,0 +1,181 @@
+"""Replicated log replica: terms, append, group commit, persistence.
+
+Reference analog: PalfHandleImpl + LogSlidingWindow + LogEngine/LogIOWorker
+(src/logservice/palf/palf_handle_impl.cpp:406 submit_log, :3235
+receive_log; log_sliding_window.cpp group buffers; log_engine.cpp disk IO).
+
+Model (single log stream): entries are (term, lsn, payload bytes).  The
+leader assigns LSNs, appends to its local log, and ships entries to
+followers; an entry is committed once a majority has persisted it, after
+which the apply callback fires in LSN order on every replica (leader
+apply ≙ applyservice, follower ≙ replayservice).  Consistency follows the
+standard term-match rule: a follower accepts entries only when the
+previous entry's term matches (truncating divergent suffixes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+_HDR = struct.Struct("<QQI")  # term, lsn(index), payload_len
+
+
+@dataclass
+class LogEntry:
+    term: int
+    lsn: int          # 1-based dense index
+    payload: bytes
+
+    def encode(self) -> bytes:
+        return _HDR.pack(self.term, self.lsn, len(self.payload)) + self.payload
+
+
+class PalfReplica:
+    """One replica of one log stream (host state machine + disk log)."""
+
+    def __init__(self, replica_id: int, log_dir: str | None = None,
+                 apply_cb: Optional[Callable] = None):
+        self.replica_id = replica_id
+        self.log_dir = log_dir
+        self.apply_cb = apply_cb
+        self.entries: list[LogEntry] = []   # 0-based list, lsn = idx+1
+        self.committed_lsn = 0
+        self.applied_lsn = 0
+        self.current_term = 0
+        self.voted_for: dict[int, int] = {}  # term -> candidate
+        self.role = "follower"
+        self._lock = threading.RLock()
+        self._log_f = None
+        if log_dir is not None:
+            os.makedirs(log_dir, exist_ok=True)
+            self._recover()
+
+    # ------------------------------------------------------------------
+    # persistence (≙ LogEngine block files; single append file here)
+    # ------------------------------------------------------------------
+    def _log_path(self):
+        return os.path.join(self.log_dir, f"replica_{self.replica_id}.log")
+
+    def _persist(self, entries: list[LogEntry]):
+        if self.log_dir is None:
+            return
+        if self._log_f is None:
+            self._log_f = open(self._log_path(), "ab")
+        for e in entries:
+            self._log_f.write(e.encode())
+        self._log_f.flush()
+        os.fsync(self._log_f.fileno())
+
+    def _truncate_disk(self):
+        """Rewrite the on-disk log after a suffix truncation."""
+        if self.log_dir is None:
+            return
+        if self._log_f:
+            self._log_f.close()
+            self._log_f = None
+        tmp = self._log_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            for e in self.entries:
+                f.write(e.encode())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._log_path())
+
+    def _recover(self):
+        path = self._log_path()
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            buf = f.read()
+        off = 0
+        while off + _HDR.size <= len(buf):
+            term, lsn, plen = _HDR.unpack_from(buf, off)
+            off += _HDR.size
+            if off + plen > len(buf):
+                break  # torn tail write: discard (≙ log tail scan)
+            self.entries.append(LogEntry(term, lsn, buf[off:off + plen]))
+            off += plen
+        if self.entries:
+            self.current_term = self.entries[-1].term
+
+    # ------------------------------------------------------------------
+    # leader path
+    # ------------------------------------------------------------------
+    def leader_append(self, payloads: list[bytes]) -> list[LogEntry]:
+        """Group append (≙ submit_log into the sliding window's group
+        buffer): assigns LSNs and persists locally in one fsync."""
+        with self._lock:
+            assert self.role == "leader"
+            out = []
+            for p in payloads:
+                e = LogEntry(self.current_term, len(self.entries) + 1, p)
+                self.entries.append(e)
+                out.append(e)
+            self._persist(out)
+            return out
+
+    def last_lsn(self) -> int:
+        with self._lock:
+            return len(self.entries)
+
+    def term_at(self, lsn: int) -> int:
+        with self._lock:
+            if lsn == 0:
+                return 0
+            if lsn <= len(self.entries):
+                return self.entries[lsn - 1].term
+            return -1
+
+    # ------------------------------------------------------------------
+    # follower path (≙ receive_log)
+    # ------------------------------------------------------------------
+    def accept(self, prev_lsn: int, prev_term: int,
+               entries: list[LogEntry]) -> bool:
+        with self._lock:
+            if prev_lsn > len(self.entries):
+                return False  # gap
+            if prev_lsn > 0 and self.entries[prev_lsn - 1].term != prev_term:
+                return False  # divergent history at prev
+            truncated = False
+            appended: list[LogEntry] = []
+            for e in entries:
+                if e.lsn <= len(self.entries):
+                    if self.entries[e.lsn - 1].term != e.term:
+                        del self.entries[e.lsn - 1:]
+                        truncated = True
+                    else:
+                        continue  # duplicate
+                self.entries.append(e)
+                appended.append(e)
+            if truncated:
+                self._truncate_disk()  # rewrites including appended suffix
+            else:
+                self._persist(appended)
+            return True
+
+    # ------------------------------------------------------------------
+    # commit + apply (≙ committed_end_lsn advance + apply/replay service)
+    # ------------------------------------------------------------------
+    def advance_commit(self, commit_lsn: int):
+        with self._lock:
+            commit_lsn = min(commit_lsn, len(self.entries))
+            if commit_lsn > self.committed_lsn:
+                self.committed_lsn = commit_lsn
+            self._apply_committed()
+
+    def _apply_committed(self):
+        while self.applied_lsn < self.committed_lsn:
+            e = self.entries[self.applied_lsn]
+            self.applied_lsn += 1
+            if self.apply_cb is not None:
+                self.apply_cb(e)
+
+    def close(self):
+        if self._log_f:
+            self._log_f.close()
+            self._log_f = None

@@ -1,0 +1,10 @@
+"""Server runtime: database boot, sessions, tenants, config, observability.
+
+Reference analog: src/observer — ObServer boot (ob_server.cpp:228),
+multi-tenancy (omt/), the MySQL frontend, and the MTL module registry
+(src/share/rc/ob_tenant_base.h:615).
+"""
+
+from oceanbase_tpu.server.database import Database
+
+__all__ = ["Database"]

@@ -1,0 +1,70 @@
+"""Database: boot/recovery wiring of storage + WAL + tx + catalog.
+
+Reference analog: ObServer::init/start (src/observer/ob_server.cpp:228) —
+config load, storage meta replay (slog checkpoint), palf restart, replay
+service catch-up — collapsed to the single-node single-tenant boot:
+
+    manifest/segments load -> WAL (palf) recovery -> replay committed
+    records newer than the checkpoint into memtables -> GTS re-seeded.
+
+``Database.session()`` hands out SQL sessions bound to this instance
+(≙ MySQL frontend connections).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from oceanbase_tpu.palf.cluster import PalfCluster
+from oceanbase_tpu.storage.engine import StorageCatalog, StorageEngine
+from oceanbase_tpu.tx.service import TransService
+
+
+class Database:
+    def __init__(self, root: str | None = None, wal_replicas: int = 3):
+        data_dir = os.path.join(root, "data") if root else None
+        wal_dir = os.path.join(root, "wal") if root else None
+        if wal_dir:
+            os.makedirs(wal_dir, exist_ok=True)
+        self.engine = StorageEngine(data_dir)
+        self.wal = PalfCluster(wal_replicas, log_root=wal_dir)
+        self.wal.elect()
+        self.tx = TransService(wal=self.wal)
+
+        # replay committed WAL newer than the storage checkpoint
+        ldr = self.wal.replicas[self.wal.leader_id]
+        start = self.engine.meta.get("wal_lsn", 0)
+        committed = ldr.committed_lsn
+        if committed > start:
+            max_ts = TransService.replay(
+                ldr.entries[start:committed], self.engine)
+            self.tx.gts.advance_to(max_ts)
+        self.tx.gts.advance_to(self.engine.meta.get("gts", 0))
+
+        self.catalog = StorageCatalog(
+            self.engine, snapshot_fn=self.tx.gts.current)
+
+    def session(self):
+        from oceanbase_tpu.sql.session import Session
+
+        return Session(self.catalog, db=self)
+
+    # ------------------------------------------------------------------
+    def checkpoint(self):
+        """Freeze+flush all tables, then checkpoint storage meta recording
+        the WAL replay point (≙ clog checkpoint advancing so logs recycle)."""
+        snap = self.tx.gts.current()
+        for name in list(self.engine.tables):
+            self.engine.freeze_and_flush(name, snapshot=snap)
+        replay_point = self.wal.committed_lsn()
+        oldest_live = self.tx.min_active_wal_lsn()
+        if oldest_live is not None:
+            # live transactions' redo must survive for crash recovery
+            replay_point = min(replay_point, oldest_live - 1)
+        self.engine.meta["wal_lsn"] = replay_point
+        self.engine.meta["gts"] = self.tx.gts.current()
+        self.engine.checkpoint()
+
+    def close(self):
+        self.wal.close()

@@ -75,14 +75,15 @@ class Session:
 
     MAX_CAPACITY_RETRIES = 3
 
-    def __init__(self, catalog: Catalog | None = None, tenant=None):
+    def __init__(self, catalog: Catalog | None = None, tenant=None, db=None):
         self.catalog = catalog if catalog is not None else Catalog()
         self.tenant = tenant
+        self.db = db  # server.Database when backed by the storage/tx plane
         self.variables: dict[str, object] = {
             "autocommit": 1, "max_capacity_retry": self.MAX_CAPACITY_RETRIES,
         }
         self.plan_cache: dict[str, tuple] = {}
-        self._tx = None  # transaction handle (tx plane)
+        self._tx = None  # active explicit transaction (BEGIN ... COMMIT)
 
     # ------------------------------------------------------------------
     def execute(self, sql: str, params: list | None = None) -> Result:
@@ -133,10 +134,22 @@ class Session:
         binder = Binder(self.catalog, params=params or [])
         return binder.bind_select(stmt)
 
+    def _table_snapshot(self, name: str):
+        """Read a table at the right snapshot: an active transaction sees
+        its own writes plus its begin-snapshot; otherwise latest committed
+        (cached device relation)."""
+        if self.db is not None and self._tx is not None:
+            return self.catalog.table_data_at(
+                name, self._tx.snapshot, self._tx.tx_id)
+        return self.catalog.table_data(name)
+
     def _execute_select(self, stmt: ast.SelectStmt, params) -> Result:
+        from oceanbase_tpu.exec.plan import referenced_tables
+
         plan, outputs, _est = self._plan_select(stmt, params)
-        tables = {t: self.catalog.table_data(t)
-                  for t in self.catalog.tables()}
+        tables = {t: self._table_snapshot(t)
+                  for t in referenced_tables(plan)
+                  if self.catalog.has_table(t)}
         factor = 1
         for attempt in range(int(self.variables["max_capacity_retry"]) + 1):
             try:
@@ -184,6 +197,8 @@ class Session:
         cols = [ColumnDef(c.name, c.dtype, c.nullable) for c in stmt.columns]
         tdef = TableDef(stmt.name, cols, primary_key=stmt.primary_key)
         self.catalog.create_table(tdef, if_not_exists=stmt.if_not_exists)
+        if self.db is not None:
+            return _ok()  # the engine serves empty snapshots itself
         # seed an all-dead single-row relation (static shapes need cap >= 1)
         arrays, valids = {}, {}
         for c in stmt.columns:
@@ -202,7 +217,192 @@ class Session:
         self.catalog.set_data(stmt.name, rel)
         return _ok()
 
+    # ------------------------------------------------------------------
+    # transactional DML (storage/tx plane)
+    # ------------------------------------------------------------------
+    def _run_in_tx(self, fn):
+        """Run fn(tx) in the active explicit transaction (with
+        statement-level rollback on failure) or an autocommit one
+        (≙ implicit transactions around single statements)."""
+        if self._tx is not None:
+            tx = self._tx
+            tx.stmt_seq += 1
+            seq = tx.stmt_seq
+            writes_before = {t: len(p.keys)
+                             for t, p in tx.participants.items()}
+            try:
+                return fn(tx)
+            except Exception:
+                stmt_writes = {}
+                for t, p in tx.participants.items():
+                    new = p.keys[writes_before.get(t, 0):]
+                    if new:
+                        stmt_writes[t] = new
+                self.db.tx.rollback_statement(tx, seq, stmt_writes)
+                raise
+        tx = self.db.tx.begin()
+        try:
+            out = fn(tx)
+        except Exception:
+            self.db.tx.rollback(tx)
+            raise
+        self.db.tx.commit(tx)
+        return out
+
+    def _insert_tx(self, stmt: ast.InsertStmt, params) -> Result:
+        td = self.catalog.table_def(stmt.table)
+        cols = stmt.columns or td.column_names
+        rows_values: list[dict] = []
+        if stmt.rows is not None:
+            for row in stmt.rows:
+                if len(row) != len(cols):
+                    raise ValueError("INSERT arity mismatch")
+                values: dict = {}
+                for c, e in zip(cols, row):
+                    v, t = literal_value(_as_literal(e, params))
+                    cdef = td.column(c)
+                    values[c] = _coerce_value(v, t, cdef.dtype)
+                for c in td.columns:
+                    values.setdefault(c.name, None)
+                rows_values.append(values)
+        else:
+            sub = self._execute_select(stmt.select, params)
+            for i in range(sub.rowcount):
+                values = {}
+                for c, sn in zip(cols, sub.names):
+                    x = sub.arrays[sn][i]
+                    vd = sub.valids.get(sn)
+                    if vd is not None and not vd[i]:
+                        values[c] = None
+                    else:
+                        values[c] = x.item() if hasattr(x, "item") else x
+                for c in td.columns:
+                    values.setdefault(c.name, None)
+                rows_values.append(values)
+        tablet = self.db.engine.tables[stmt.table].tablet
+
+        def op(tx):
+            for values in rows_values:
+                key = tablet.make_key(values)
+                self.db.tx.write(tx, stmt.table, tablet, key, "insert",
+                                 values)
+
+        self._run_in_tx(op)
+        self.catalog.invalidate(stmt.table)
+        return _ok(rowcount=len(rows_values))
+
+    def _matching_rows(self, table: str, where, params):
+        """-> (rel, mask, tablet): snapshot relation + WHERE mask."""
+        from oceanbase_tpu.expr.compile import eval_predicate
+        from oceanbase_tpu.sql.binder import Binder, Scope
+
+        tablet = self.db.engine.tables[table].tablet
+        snap = (self._tx.snapshot if self._tx is not None
+                else self.db.tx.gts.current())
+        tx_id = self._tx.tx_id if self._tx is not None else 0
+        rel = self.catalog.table_data_at(table, snap, tx_id)
+        binder = Binder(self.catalog, params=params or [])
+        scope = Scope()
+        for cname in rel.columns:
+            scope.add(cname, cname, alias=table)
+        if where is not None:
+            pred = binder.bind_expr(where, scope)
+            mask = eval_predicate(pred, rel)
+        else:
+            mask = rel.mask_or_true()
+        return rel, mask, tablet, binder, scope
+
+    def _update_tx(self, stmt: ast.UpdateStmt, params) -> Result:
+        from oceanbase_tpu.expr.compile import cast_column, eval_expr
+
+        td = self.catalog.table_def(stmt.table)
+        rel, mask, tablet, binder, scope = self._matching_rows(
+            stmt.table, stmt.where, params)
+        # evaluate assignments over the snapshot, then pull matched rows
+        new_cols = {}
+        for cname, e in stmt.assignments:
+            b = binder.bind_expr(e, scope)
+            c = eval_expr(b, rel)
+            new_cols[cname] = cast_column(c, td.column(cname).dtype)
+        matched = to_numpy(rel.with_mask(mask))
+        n_upd = len(next(iter(matched.values()))) if matched else 0
+        new_host = {}
+        import numpy as _np
+
+        midx = _np.nonzero(_np.asarray(mask))[0]
+        for cname, c in new_cols.items():
+            vals = _np.asarray(c.data)[midx]
+            if c.sdict is not None:
+                vals = c.sdict.values[_np.clip(vals, 0, c.sdict.size - 1)]
+            vv = (_np.asarray(c.valid)[midx] if c.valid is not None
+                  else _np.ones(len(midx), dtype=bool))
+            new_host[cname] = (vals, vv)
+
+        key_changed = any(c in tablet.key_cols for c, _ in stmt.assignments)
+
+        def op(tx):
+            for i in range(n_upd):
+                old_values = {}
+                for c in tablet.columns:
+                    if c in matched:
+                        x = matched[c][i]
+                        vd = matched.get("__valid__" + c)
+                        old_values[c] = (None if vd is not None and not vd[i]
+                                         else (x.item() if hasattr(x, "item")
+                                               else x))
+                values = dict(old_values)
+                for cname, (vals, vv) in new_host.items():
+                    x = vals[i]
+                    values[cname] = (None if not vv[i]
+                                     else (x.item() if hasattr(x, "item")
+                                           else x))
+                new_key = tuple(values[k] for k in tablet.key_cols)
+                if key_changed:
+                    old_key = tuple(old_values[k] for k in tablet.key_cols)
+                    if old_key != new_key:
+                        # PK update = delete old row + insert new row
+                        self.db.tx.write(tx, stmt.table, tablet, old_key,
+                                         "delete", old_values)
+                        self.db.tx.write(tx, stmt.table, tablet, new_key,
+                                         "insert", values)
+                        continue
+                self.db.tx.write(tx, stmt.table, tablet, new_key, "update",
+                                 values)
+
+        self._run_in_tx(op)
+        self.catalog.invalidate(stmt.table)
+        return _ok(rowcount=n_upd)
+
+    def _delete_tx(self, stmt: ast.DeleteStmt, params) -> Result:
+        rel, mask, tablet, _b, _s = self._matching_rows(
+            stmt.table, stmt.where, params)
+        matched = to_numpy(rel.with_mask(mask))
+        n_del = len(next(iter(matched.values()))) if matched else 0
+
+        def op(tx):
+            for i in range(n_del):
+                values = {}
+                for c in tablet.columns:
+                    if c in matched:
+                        x = matched[c][i]
+                        vd = matched.get("__valid__" + c)
+                        values[c] = (None if vd is not None and not vd[i]
+                                     else (x.item() if hasattr(x, "item")
+                                           else x))
+                key = tuple(values[k] for k in tablet.key_cols)
+                self.db.tx.write(tx, stmt.table, tablet, key, "delete",
+                                 values)
+
+        self._run_in_tx(op)
+        self.catalog.invalidate(stmt.table)
+        return _ok(rowcount=n_del)
+
+    # ------------------------------------------------------------------
+    # legacy host-side DML (catalog without a storage engine)
+    # ------------------------------------------------------------------
     def _insert(self, stmt: ast.InsertStmt, params) -> Result:
+        if self.db is not None:
+            return self._insert_tx(stmt, params)
         td = self.catalog.table_def(stmt.table)
         cols = stmt.columns or td.column_names
         if stmt.rows is not None:
@@ -286,11 +486,9 @@ class Session:
         return _ok(rowcount=n_new)
 
     def _update(self, stmt: ast.UpdateStmt, params) -> Result:
-        sel = ast.SelectStmt(items=[(ast.Star(), None)],
-                             from_=[ast.TableRef(stmt.table)],
-                             where=stmt.where)
-        # evaluate the WHERE mask + new values host-side (placeholder for
-        # the MVCC write path)
+        if self.db is not None:
+            return self._update_tx(stmt, params)
+        # host-side fallback (no storage engine attached)
         td = self.catalog.table_def(stmt.table)
         rel = self.catalog.table_data(stmt.table)
         binder = Binder(self.catalog, params=params or [])
@@ -331,6 +529,8 @@ class Session:
         return _ok(rowcount=n_upd)
 
     def _delete(self, stmt: ast.DeleteStmt, params) -> Result:
+        if self.db is not None:
+            return self._delete_tx(stmt, params)
         td = self.catalog.table_def(stmt.table)
         rel = self.catalog.table_data(stmt.table)
         binder = Binder(self.catalog, params=params or [])
@@ -354,7 +554,20 @@ class Session:
         return _ok(rowcount=n_del)
 
     def _tx_control(self, op: str) -> Result:
-        # wired to the tx plane (oceanbase_tpu.tx) as it lands
+        if self.db is None:
+            return _ok()
+        if op == "begin":
+            if self._tx is not None:
+                self.db.tx.commit(self._tx)  # implicit commit (MySQL)
+            self._tx = self.db.tx.begin()
+        elif op == "commit":
+            if self._tx is not None:
+                self.db.tx.commit(self._tx)
+                self._tx = None
+        elif op == "rollback":
+            if self._tx is not None:
+                self.db.tx.rollback(self._tx)
+                self._tx = None
         return _ok()
 
 
@@ -370,6 +583,26 @@ def _as_literal(e, params) -> ir.Literal:
         return ir.Literal({"+": lv + rv, "-": lv - rv, "*": lv * rv}
                           [e.op])
     raise ValueError("INSERT VALUES must be literals")
+
+
+def _coerce_value(v, t, target: SqlType):
+    """Coerce a parsed literal (value, type) to a column's storage value."""
+    if v is None:
+        return None
+    if target.kind == TypeKind.DECIMAL:
+        if t.kind == TypeKind.DECIMAL:
+            return _rescale(v, t.scale, target.scale)
+        if isinstance(v, int):
+            return v * _POW10[target.scale]
+        if isinstance(v, float):
+            return round(v * _POW10[target.scale])
+    if target.kind == TypeKind.DATE and isinstance(v, str):
+        from oceanbase_tpu.datatypes import date_to_days
+
+        return date_to_days(v)
+    if target.kind == TypeKind.BOOL:
+        return bool(v)
+    return v
 
 
 def _rescale(v: int, from_scale: int, to_scale: int) -> int:

@@ -69,12 +69,13 @@ class EncodedColumn:
 def _zone(arr: np.ndarray, valid) -> ZoneMap:
     n = len(arr)
     nulls = 0 if valid is None else int((~valid).sum())
-    if n == 0 or nulls == n or arr.dtype == object:
-        live = arr[valid] if valid is not None else arr
-        if len(live) and arr.dtype != object:
-            return ZoneMap(live.min(), live.max(), nulls, n)
-        return ZoneMap(None, None, nulls, n)
     live = arr[valid] if valid is not None else arr
+    if n == 0 or nulls == n or len(live) == 0:
+        return ZoneMap(None, None, nulls, n)
+    if arr.dtype == object or arr.dtype.kind in "US":
+        # numpy 2.x has no min/max ufunc loop for strings
+        vals = live.tolist()
+        return ZoneMap(min(vals), max(vals), nulls, n)
     return ZoneMap(live.min(), live.max(), nulls, n)
 
 

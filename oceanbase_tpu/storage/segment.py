@@ -1,0 +1,266 @@
+"""Immutable column segments (SSTable analog).
+
+Reference analog: ObSSTable macro/micro blocks + column store CG files
+(src/storage/blocksstable, src/storage/column_store).  A segment is the
+unit the LSM produces at freeze/compaction time: per-column encoded chunks
+with zone maps, optionally persisted as one .npz file, decoded column-wise
+straight into the device upload path.
+
+Layout: rows are chunked (CHUNK_ROWS ≙ micro block); each (column, chunk)
+is independently encoded and zone-mapped so scans can skip chunks from
+pushdown ranges (≙ blockscan + index-block skipping,
+src/storage/access/ob_multiple_scan_merge.cpp:209).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from oceanbase_tpu.datatypes import SqlType
+from oceanbase_tpu.storage.encoding import (
+    EncodedColumn,
+    decode_column,
+    encode_column,
+)
+
+CHUNK_ROWS = 65536
+
+
+@dataclass
+class Segment:
+    """Immutable sorted-run of rows for one tablet."""
+
+    segment_id: int
+    level: int                      # 0 = mini (L0), 1 = minor, 2 = major
+    n_rows: int
+    columns: dict                   # name -> list[EncodedColumn] per chunk
+    types: dict                     # name -> SqlType
+    # commit-version range covered (MVCC): rows in this segment are visible
+    # to snapshots >= max_version
+    min_version: int = 0
+    max_version: int = 0
+
+    @property
+    def n_chunks(self) -> int:
+        any_col = next(iter(self.columns.values()))
+        return len(any_col)
+
+    def nbytes(self) -> int:
+        return sum(ec.nbytes() for chunks in self.columns.values()
+                   for ec in chunks)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def build(segment_id: int, level: int, arrays: dict, types: dict,
+              valids: dict | None = None, min_version=0, max_version=0
+              ) -> "Segment":
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        cols: dict[str, list[EncodedColumn]] = {}
+        for name, arr in arrays.items():
+            valid = (valids or {}).get(name)
+            chunks = []
+            for s in range(0, max(n, 1), CHUNK_ROWS):
+                e = min(s + CHUNK_ROWS, n)
+                v = valid[s:e] if valid is not None else None
+                chunks.append(encode_column(np.asarray(arr[s:e]), v))
+            cols[name] = chunks
+        return Segment(segment_id, level, n, cols, dict(types),
+                       min_version, max_version)
+
+    def decode(self, names=None, chunk_mask=None):
+        """-> (arrays, valids) decoded host columns, optionally skipping
+        chunks (zone-map pruning)."""
+        names = names if names is not None else list(self.columns)
+        arrays, valids = {}, {}
+        for name in names:
+            chunks = self.columns[name]
+            parts, vparts = [], []
+            has_valid = any(c.valid is not None for c in chunks)
+            for i, ec in enumerate(chunks):
+                if chunk_mask is not None and not chunk_mask[i]:
+                    continue
+                parts.append(decode_column(ec))
+                if has_valid:
+                    vparts.append(ec.valid if ec.valid is not None
+                                  else np.ones(ec.n, dtype=bool))
+            if not parts:
+                dt = self.types[name].np_dtype
+                arrays[name] = np.zeros(0, dtype=object
+                                        if self.types[name].is_string else dt)
+                valids[name] = None
+                continue
+            arrays[name] = np.concatenate(parts)
+            valids[name] = np.concatenate(vparts) if has_valid else None
+        return arrays, valids
+
+    def prune_chunks(self, col: str, lo, hi) -> np.ndarray:
+        """Zone-map chunk pruning for a range predicate on ``col``
+        (≙ index-block skip, the blockscan fast path)."""
+        chunks = self.columns.get(col)
+        if chunks is None:
+            return np.ones(self.n_chunks, dtype=bool)
+        return np.array([ec.zone.may_match_range(lo, hi) for ec in chunks])
+
+    # ------------------------------------------------------------------
+    # persistence (≙ macro-block file + manifest entry)
+    # ------------------------------------------------------------------
+    def save(self, path: str):
+        payload = {}
+        meta = {
+            "segment_id": self.segment_id, "level": self.level,
+            "n_rows": self.n_rows, "min_version": self.min_version,
+            "max_version": self.max_version,
+            "cols": {}, "types": {},
+        }
+        for name, t in self.types.items():
+            meta["types"][name] = [t.kind.value, t.precision, t.scale]
+        for name, chunks in self.columns.items():
+            meta["cols"][name] = []
+            for i, ec in enumerate(chunks):
+                centry = {"encoding": ec.encoding, "n": ec.n,
+                          "keys": list(ec.payload),
+                          "zone": [None if ec.zone.vmin is None else
+                                   _scalar(ec.zone.vmin),
+                                   None if ec.zone.vmax is None else
+                                   _scalar(ec.zone.vmax),
+                                   ec.zone.null_count, ec.zone.row_count]}
+                for k, v in ec.payload.items():
+                    payload[f"{name}/{i}/{k}"] = np.asarray(v)
+                if ec.valid is not None:
+                    payload[f"{name}/{i}/__valid__"] = ec.valid
+                    centry["has_valid"] = True
+                meta["cols"][name].append(centry)
+        import json
+
+        payload["__meta__"] = np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **payload)
+        os.replace(tmp, path)  # atomic publish (≙ macro block seal)
+
+    @staticmethod
+    def load(path: str) -> "Segment":
+        import json
+
+        from oceanbase_tpu.datatypes import TypeKind
+        from oceanbase_tpu.storage.encoding import ZoneMap
+
+        with np.load(path, allow_pickle=True) as z:
+            meta = json.loads(bytes(z["__meta__"]).decode())
+            types = {n: SqlType(TypeKind(k), p, s)
+                     for n, (k, p, s) in meta["types"].items()}
+            cols = {}
+            for name, centries in meta["cols"].items():
+                chunks = []
+                for i, ce in enumerate(centries):
+                    payload = {k: z[f"{name}/{i}/{k}"] for k in ce["keys"]}
+                    valid = None
+                    if ce.get("has_valid"):
+                        valid = z[f"{name}/{i}/__valid__"]
+                    zn = ce["zone"]
+                    chunks.append(EncodedColumn(
+                        ce["encoding"], payload, valid,
+                        ZoneMap(zn[0], zn[1], zn[2], zn[3]), ce["n"]))
+                cols[name] = chunks
+        return Segment(meta["segment_id"], meta["level"], meta["n_rows"],
+                       cols, types, meta["min_version"], meta["max_version"])
+
+
+def _scalar(v):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.str_, str)):
+        return str(v)
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    return v
+
+
+def merge_segments(segment_id: int, level: int, segments: list,
+                   key_cols: list[str], drop_tombstones: bool) -> Segment:
+    """Compaction merge: stack rows, newest version of each key wins
+    (≙ ObPartitionMerger major/minor merge,
+    src/storage/compaction/ob_partition_merger.h:140).
+
+    Segments must be given oldest-first; key_cols empty -> append-only
+    merge (no dedup).  ``drop_tombstones`` must be True only when the merge
+    covers EVERY level (major merge) — otherwise a tombstone may shadow a
+    base row in a lower level outside the merge set and must be retained.
+
+    The column set is the UNION across inputs: segments built from bulk
+    load lack the __deleted__/__version__ bookkeeping columns that
+    memtable flushes carry; missing columns fill with defaults
+    (not-deleted, version = segment max_version).
+    """
+    if not segments:
+        raise ValueError("nothing to merge")
+    types: dict = {}
+    for seg in segments:
+        for n, t in seg.types.items():
+            types.setdefault(n, t)
+    all_arrays = []
+    all_valids = []
+    for seg in segments:
+        a, v = seg.decode()
+        n_rows = len(next(iter(a.values()))) if a else 0
+        for n, t in types.items():
+            if n not in a:
+                if n == "__deleted__":
+                    a[n] = np.zeros(n_rows, dtype=bool)
+                elif n == "__version__":
+                    a[n] = np.full(n_rows, seg.max_version, dtype=np.int64)
+                else:
+                    a[n] = (np.array([""] * n_rows, dtype=object)
+                            if t.is_string else
+                            np.zeros(n_rows, dtype=t.np_dtype))
+                    v[n] = np.zeros(n_rows, dtype=bool)  # NULL-filled
+        all_arrays.append(a)
+        all_valids.append(v)
+    names = list(types)
+    stacked = {}
+    stacked_valid = {}
+    for n in names:
+        parts = [a[n] for a in all_arrays]
+        if any(p.dtype == object for p in parts):
+            parts = [p.astype(object) for p in parts]
+        stacked[n] = np.concatenate(parts)
+        if any(v.get(n) is not None for v in all_valids):
+            stacked_valid[n] = np.concatenate(
+                [v[n] if v.get(n) is not None
+                 else np.ones(len(a[n]), bool)
+                 for v, a in zip(all_valids, all_arrays)])
+    total = len(next(iter(stacked.values()))) if names else 0
+
+    keep = np.ones(total, dtype=bool)
+    if key_cols and total:
+        # newest wins: iterate from the end (newest segment last)
+        key_arrays = [stacked[k] for k in key_cols]
+        seen: set = set()
+        order = np.arange(total - 1, -1, -1)
+        for idx in order:
+            key = tuple(a[idx] for a in key_arrays)
+            if key in seen:
+                keep[idx] = False
+            else:
+                seen.add(key)
+    if "__deleted__" in stacked and drop_tombstones:
+        keep &= ~stacked["__deleted__"].astype(bool)
+        del stacked["__deleted__"]
+        stacked_valid.pop("__deleted__", None)
+        types.pop("__deleted__", None)
+
+    out_arrays = {n: stacked[n][keep] for n in stacked}
+    out_valids = {n: v[keep] for n, v in stacked_valid.items()}
+    return Segment.build(
+        segment_id, level, out_arrays, types, out_valids,
+        min_version=min(s.min_version for s in segments),
+        max_version=max(s.max_version for s in segments),
+    )

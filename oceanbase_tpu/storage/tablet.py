@@ -1,0 +1,291 @@
+"""Tablet: one partition's LSM — memtables + leveled segments.
+
+Reference analog: ObTablet (src/storage/tablet) owning memtables and an
+SSTable table-store; freeze/mini/minor/major compaction driven by the
+tenant scheduler (src/storage/compaction/ob_tenant_tablet_scheduler.h:140).
+
+Read path: ``snapshot_arrays`` fuses base segments (oldest..newest,
+newest-wins by primary key) with the visible memtable overlay — the TPU
+build's version of ObMultipleScanMerge fusing memtable + SSTables
+(src/storage/access/ob_multiple_merge.cpp:507), done column-wise on host
+metadata before the device upload instead of row-at-a-time.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from oceanbase_tpu.datatypes import SqlType
+from oceanbase_tpu.storage.memtable import MemTable
+from oceanbase_tpu.storage.segment import Segment, merge_segments
+
+
+class Tablet:
+    def __init__(self, tablet_id: int, columns: list[str],
+                 types: dict[str, SqlType], key_cols: list[str]):
+        self.tablet_id = tablet_id
+        self.columns = list(columns)
+        self.types = dict(types)
+        self.key_cols = list(key_cols)
+        self.active = MemTable(0)
+        self.frozen: list[MemTable] = []
+        self.segments: list[Segment] = []   # oldest first
+        self._next_mt = itertools.count(1)
+        self._next_seg = itertools.count(1)
+        self._lock = threading.RLock()
+        self._auto_key = itertools.count()  # rowid for keyless tables
+        self.data_version = 0               # bumps on any visible change
+
+    # ------------------------------------------------------------------
+    def make_key(self, values: dict) -> tuple:
+        if self.key_cols == ["__rowid__"] and "__rowid__" not in values:
+            values["__rowid__"] = self.next_rowid(1)
+        return tuple(values[k] for k in self.key_cols)
+
+    def next_rowid(self, n: int) -> int:
+        """Allocate n consecutive hidden rowids (restart-safe: seeded from
+        the max persisted rowid on first use)."""
+        with self._lock:
+            if not hasattr(self, "_rowid_base"):
+                base = 0
+                for seg in self.segments:
+                    chunks = seg.columns.get("__rowid__")
+                    if chunks:
+                        for ec in chunks:
+                            if ec.zone.vmax is not None:
+                                base = max(base, int(ec.zone.vmax) + 1)
+                # rows replayed from the WAL live only in memtables
+                if self.key_cols == ["__rowid__"]:
+                    for mt in [self.active] + self.frozen:
+                        for key in mt._rows:
+                            base = max(base, int(key[0]) + 1)
+                self._rowid_base = base
+            out = self._rowid_base
+            self._rowid_base += n
+            return out
+
+    def write(self, key: tuple, op: str, values: dict, tx_id: int,
+              stmt_seq: int = 0):
+        with self._lock:
+            v = self.active.write(key, op, values, tx_id, stmt_seq)
+            return v
+
+    def commit(self, tx_id: int, commit_version: int, keys):
+        with self._lock:
+            self.active.commit(tx_id, commit_version, keys)
+            for mt in self.frozen:
+                mt.commit(tx_id, commit_version, keys)
+            self.data_version += 1
+
+    def abort(self, tx_id: int, keys, min_stmt_seq: int = 0):
+        with self._lock:
+            self.active.abort(tx_id, keys, min_stmt_seq)
+            for mt in self.frozen:
+                mt.abort(tx_id, keys, min_stmt_seq)
+
+    # ------------------------------------------------------------------
+    # compaction (≙ mini/minor/major merge DAGs)
+    # ------------------------------------------------------------------
+    def freeze(self):
+        with self._lock:
+            if len(self.active) == 0:
+                return None
+            mt = self.active.freeze()
+            self.frozen.append(mt)
+            self.active = MemTable(next(self._next_mt))
+            return mt
+
+    def mini_compact(self, snapshot: int):
+        """Frozen memtables -> one L0 segment.
+
+        Versions the flush snapshot cannot capture (uncommitted, or
+        committed after the snapshot) are CARRIED OVER into the active
+        memtable instead of being dropped — a frozen memtable may hold a
+        live transaction's writes (≙ the reference's freeze waiting on
+        active tx handover; we migrate instead of waiting)."""
+        with self._lock:
+            if not self.frozen:
+                return None
+            parts = []
+            leftovers: list[dict] = []
+            for mt in self.frozen:
+                arrays, valids = mt.to_arrays(self.columns, self.types,
+                                              snapshot)
+                parts.append((arrays, valids, mt))
+                leftovers.append(mt.leftover_versions(snapshot))
+            merged_arrays, merged_valids = _stack_parts(parts, self.columns,
+                                                        self.types)
+            seg = Segment.build(
+                next(self._next_seg), 0, merged_arrays,
+                {**self.types, "__deleted__": SqlType.bool_(),
+                 "__version__": SqlType.int_()},
+                merged_valids,
+                min_version=min((mt.min_version for _, _, mt in parts
+                                 if mt.max_version > 0), default=snapshot),
+                max_version=max((mt.max_version for _, _, mt in parts),
+                                default=snapshot),
+            )
+            self.segments.append(seg)
+            self.frozen = []
+            for lo in leftovers:
+                self._graft_versions(lo)
+            self.data_version += 1
+            return seg
+
+    def _graft_versions(self, chains: dict):
+        """Attach carried-over version chains under the active memtable's
+        chains (active versions are strictly newer)."""
+        for key, head in chains.items():
+            cur = self.active._rows.get(key)
+            if cur is None:
+                self.active._rows[key] = head
+            else:
+                tail = cur
+                while tail.prev is not None:
+                    tail = tail.prev
+                tail.prev = head
+
+    def minor_compact(self):
+        """All L0 segments -> one L1 (≙ minor merge).  Tombstones are
+        RETAINED: the rows they shadow may live in lower levels outside
+        this merge."""
+        with self._lock:
+            l0 = [s for s in self.segments if s.level == 0]
+            if len(l0) < 2:
+                return None
+            keep = [s for s in self.segments if s.level != 0]
+            merged = merge_segments(next(self._next_seg), 1, l0,
+                                    self.key_cols, drop_tombstones=False)
+            # place after existing L1/L2 so order stays oldest-first
+            self.segments = keep + [merged]
+            self.data_version += 1
+            return merged
+
+    def major_compact(self):
+        """Everything -> one L2 baseline (≙ daily major merge); the merge
+        covers every level, so tombstones fall out here."""
+        with self._lock:
+            if not self.segments:
+                return None
+            merged = merge_segments(next(self._next_seg), 2, self.segments,
+                                    self.key_cols, drop_tombstones=True)
+            self.segments = [merged]
+            self.data_version += 1
+            return merged
+
+    # ------------------------------------------------------------------
+    # snapshot read
+    # ------------------------------------------------------------------
+    def snapshot_arrays(self, snapshot: int, tx_id: int = 0):
+        """-> (arrays, valids) visible at ``snapshot`` (plus own tx)."""
+        with self._lock:
+            seg_parts = []
+            for seg in self.segments:
+                if seg.min_version > snapshot:
+                    continue  # wholly invisible at this snapshot
+                a, v = seg.decode()
+                if seg.max_version > snapshot and "__version__" in a:
+                    vis = a["__version__"] <= snapshot
+                    a = {k: arr[vis] for k, arr in a.items()}
+                    v = {k: (vv[vis] if vv is not None else None)
+                         for k, vv in v.items()}
+                seg_parts.append((a, v, None))
+            mt_parts = []
+            for mt in self.frozen + [self.active]:
+                rows = mt.snapshot_rows(snapshot, tx_id)
+                if rows:
+                    a, v = _rows_to_arrays(rows, self.columns, self.types)
+                    mt_parts.append((a, v, None))
+        parts = seg_parts + mt_parts
+        if not parts:
+            return ({c: np.zeros(0, dtype=object if self.types[c].is_string
+                                 else self.types[c].np_dtype)
+                     for c in self.columns},
+                    {c: None for c in self.columns})
+        arrays, valids = _stack_parts(parts, self.columns, self.types)
+        n = len(next(iter(arrays.values())))
+        keep = np.ones(n, dtype=bool)
+        if self.key_cols and n:
+            key_arrays = [arrays[k] for k in self.key_cols]
+            seen: set = set()
+            for idx in range(n - 1, -1, -1):  # newest last -> wins
+                key = tuple(a[idx] for a in key_arrays)
+                if key in seen:
+                    keep[idx] = False
+                else:
+                    seen.add(key)
+        if "__deleted__" in arrays:
+            keep &= ~arrays["__deleted__"].astype(bool)
+        out_a = {c: arrays[c][keep] for c in self.columns}
+        out_v = {c: (valids[c][keep] if valids.get(c) is not None else None)
+                 for c in self.columns}
+        return out_a, out_v
+
+    def row_count_estimate(self) -> int:
+        return sum(s.n_rows for s in self.segments) + len(self.active) + \
+            sum(len(m) for m in self.frozen)
+
+
+def _rows_to_arrays(rows: dict, columns, types):
+    n = len(rows)
+    arrays = {c: [] for c in columns}
+    valids = {c: np.ones(n, dtype=bool) for c in columns}
+    deleted = np.zeros(n, dtype=bool)
+    for i, (key, v) in enumerate(sorted(rows.items())):
+        deleted[i] = v.op == "delete"
+        for c in columns:
+            val = v.values.get(c)
+            if val is None:
+                valids[c][i] = False
+                arrays[c].append("" if types[c].is_string else 0)
+            else:
+                arrays[c].append(val)
+    out = {}
+    for c in columns:
+        if types[c].is_string:
+            out[c] = np.array(arrays[c], dtype=object)
+        else:
+            out[c] = np.asarray(arrays[c], dtype=types[c].np_dtype)
+    out["__deleted__"] = deleted
+    return out, valids
+
+
+def _stack_parts(parts, columns, types):
+    """Stack (arrays, valids, _) parts preserving the hidden __deleted__
+    tombstone and __version__ commit-version columns."""
+    cols = list(columns) + ["__deleted__", "__version__"]
+    arrays = {}
+    valids = {}
+    for c in cols:
+        arrs = []
+        for a, v, _ in parts:
+            if c in a:
+                arrs.append(a[c])
+            else:
+                n = len(next(iter(a.values())))
+                if c == "__deleted__":
+                    arrs.append(np.zeros(n, dtype=bool))
+                elif c == "__version__":
+                    arrs.append(np.zeros(n, dtype=np.int64))
+                else:
+                    arrs.append(np.zeros(n, dtype=types[c].np_dtype))
+        if any(x.dtype == object for x in arrs):
+            arrs = [x.astype(object) for x in arrs]
+        arrays[c] = np.concatenate(arrs) if arrs else np.zeros(0)
+        if c != "__deleted__":
+            vparts = []
+            has = any(v.get(c) is not None for _, v, _ in parts)
+            if has:
+                for a, v, _ in parts:
+                    n = len(a[c]) if c in a else 0
+                    vv = v.get(c)
+                    vparts.append(vv if vv is not None
+                                  else np.ones(n, dtype=bool))
+                valids[c] = np.concatenate(vparts)
+            else:
+                valids[c] = None
+    return arrays, valids

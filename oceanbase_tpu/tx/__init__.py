@@ -1,0 +1,11 @@
+"""Transaction plane: MVCC transactions, GTS, two-phase commit, locks.
+
+Reference analog: src/storage/tx (ObTransService ob_trans_service.h:173,
+ObPartTransCtx ob_trans_part_ctx.h:148, 2PC state machine
+ob_committer_define.h:61) and the GTS (ob_gts_source.h).  Host-side by
+design (SURVEY north star: MVCC/tx untouched by the TPU offload).
+"""
+
+from oceanbase_tpu.tx.errors import TxAborted, WriteConflict
+
+__all__ = ["WriteConflict", "TxAborted"]

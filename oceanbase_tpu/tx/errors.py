@@ -1,0 +1,9 @@
+"""Transaction error types (≙ OB_TRY_LOCK_ROW_CONFLICT / OB_TRANS_*)."""
+
+
+class WriteConflict(RuntimeError):
+    """Row is write-locked by another live transaction."""
+
+
+class TxAborted(RuntimeError):
+    """Transaction was aborted (conflict, deadlock, or explicit rollback)."""

@@ -1,0 +1,218 @@
+"""Transaction service: MVCC transactions with WAL + two-phase commit.
+
+Reference analog: ObTransService (src/storage/tx/ob_trans_service.h:173)
+with per-participant ObPartTransCtx (ob_trans_part_ctx.h:148) and the
+optimized 2PC state machine ObTxState INIT -> REDO_COMPLETE -> PREPARE ->
+PRE_COMMIT -> COMMIT -> CLEAR (ob_committer_define.h:61-73).
+
+Model:
+- participants are tablets (the LS analog at this scale); a transaction
+  collects a write set per participant.
+- redo for every write is appended to the PALF log before commit
+  acknowledges (WAL); commit itself is a log record.  Recovery replays the
+  committed log into fresh memtables (≙ replayservice).
+- single-participant commits take the one-phase fast path; multi-
+  participant commits run the explicit 2PC state machine: each participant
+  logs PREPARE with its local max ts; commit version = max(prepare ts)
+  (≙ GTS-free prepare-version negotiation), then COMMIT records fan out.
+- conflicts fail fast with WriteConflict (lock-wait queues arrive with the
+  lock manager); rollback restores version chains.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+from dataclasses import dataclass, field
+from enum import Enum
+
+from oceanbase_tpu.tx.errors import TxAborted, WriteConflict
+from oceanbase_tpu.tx.gts import GTS
+
+
+class TxState(Enum):
+    ACTIVE = "active"
+    REDO_COMPLETE = "redo_complete"
+    PREPARE = "prepare"
+    PRE_COMMIT = "pre_commit"
+    COMMIT = "commit"
+    ABORT = "abort"
+    CLEAR = "clear"
+
+
+@dataclass
+class Participant:
+    """Per-tablet transaction context (≙ ObPartTransCtx)."""
+
+    table: str
+    tablet: object
+    keys: list = field(default_factory=list)
+    prepare_version: int = 0
+    state: TxState = TxState.ACTIVE
+
+
+@dataclass
+class Transaction:
+    tx_id: int
+    snapshot: int
+    state: TxState = TxState.ACTIVE
+    participants: dict = field(default_factory=dict)  # table -> Participant
+    stmt_seq: int = 0  # statement counter (savepoint granularity)
+    first_wal_lsn: int = 0  # first redo LSN (checkpoint barrier)
+
+    def participant(self, table: str, tablet) -> Participant:
+        p = self.participants.get(table)
+        if p is None:
+            p = Participant(table, tablet)
+            self.participants[table] = p
+        return p
+
+
+class TransService:
+    """Owns the GTS, live transactions, and the WAL (a PalfCluster)."""
+
+    def __init__(self, wal=None):
+        self.gts = GTS()
+        self.wal = wal            # PalfCluster or None (no replication)
+        self._next_tx = itertools.count(1)
+        self._live: dict[int, Transaction] = {}
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+    def begin(self) -> Transaction:
+        with self._lock:
+            tx = Transaction(next(self._next_tx), self.gts.get_ts())
+            self._live[tx.tx_id] = tx
+            return tx
+
+    def write(self, tx: Transaction, table: str, tablet, key: tuple,
+              op: str, values: dict):
+        if tx.state != TxState.ACTIVE:
+            raise TxAborted(f"tx {tx.tx_id} is {tx.state.value}")
+        tablet.write(key, op, values, tx.tx_id, stmt_seq=tx.stmt_seq)
+        p = tx.participant(table, tablet)
+        p.keys.append(key)
+        lsn = self._log({"op": "redo", "tx": tx.tx_id, "table": table,
+                         "key": list(key), "kind": op,
+                         "values": _jsonable(values)})
+        if tx.first_wal_lsn == 0 and lsn:
+            tx.first_wal_lsn = lsn
+
+    def rollback_statement(self, tx: Transaction, stmt_seq: int,
+                           stmt_writes: dict):
+        """Undo a failed statement's writes inside a live transaction
+        (statement-level atomicity, ≙ savepoint rollback).
+        stmt_writes: table -> list of keys written by the statement."""
+        for table, keys in stmt_writes.items():
+            p = tx.participants.get(table)
+            if p is None:
+                continue
+            p.tablet.abort(tx.tx_id, keys, min_stmt_seq=stmt_seq)
+            # p.keys keeps earlier-statement entries; commit() tolerates
+            # keys whose uncommitted versions were statement-aborted
+
+    # ------------------------------------------------------------------
+    def commit(self, tx: Transaction) -> int:
+        """One-phase fast path or full 2PC; returns the commit version."""
+        with self._lock:
+            if tx.state != TxState.ACTIVE:
+                raise TxAborted(f"tx {tx.tx_id} is {tx.state.value}")
+            parts = list(tx.participants.values())
+            if not parts:
+                tx.state = TxState.CLEAR
+                self._live.pop(tx.tx_id, None)
+                return self.gts.get_ts()
+            if len(parts) == 1:
+                # single-LS fast path (≙ one-phase commit optimization)
+                version = self.gts.get_ts()
+                self._log({"op": "commit", "tx": tx.tx_id,
+                           "version": version})
+                parts[0].tablet.commit(tx.tx_id, version, parts[0].keys)
+                tx.state = TxState.CLEAR
+                self._live.pop(tx.tx_id, None)
+                return version
+
+            # ---- 2PC (≙ upstream/downstream committer state machine) ----
+            tx.state = TxState.REDO_COMPLETE
+            for p in parts:
+                p.state = TxState.PREPARE
+                p.prepare_version = self.gts.get_ts()
+                self._log({"op": "prepare", "tx": tx.tx_id,
+                           "table": p.table, "version": p.prepare_version})
+            version = max(p.prepare_version for p in parts)
+            tx.state = TxState.PRE_COMMIT
+            self._log({"op": "commit", "tx": tx.tx_id, "version": version})
+            tx.state = TxState.COMMIT
+            for p in parts:
+                p.tablet.commit(tx.tx_id, version, p.keys)
+                p.state = TxState.COMMIT
+            tx.state = TxState.CLEAR
+            self._live.pop(tx.tx_id, None)
+            return version
+
+    def rollback(self, tx: Transaction):
+        with self._lock:
+            if tx.state == TxState.CLEAR:
+                return
+            for p in tx.participants.values():
+                p.tablet.abort(tx.tx_id, p.keys)
+            self._log({"op": "abort", "tx": tx.tx_id})
+            tx.state = TxState.ABORT
+            self._live.pop(tx.tx_id, None)
+
+    # ------------------------------------------------------------------
+    def _log(self, record: dict) -> int:
+        if self.wal is not None:
+            return self.wal.append([json.dumps(record).encode()])
+        return 0
+
+    def min_active_wal_lsn(self):
+        """Oldest live transaction's first redo LSN — the checkpoint must
+        not advance the replay point past it (≙ clog recycle point bounded
+        by active tx)."""
+        with self._lock:
+            lsns = [tx.first_wal_lsn for tx in self._live.values()
+                    if tx.first_wal_lsn > 0]
+            return min(lsns) if lsns else None
+
+    # ------------------------------------------------------------------
+    # recovery (≙ replayservice applying committed log to memtables)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def replay(entries, engine):
+        """Replay committed WAL records into a StorageEngine's memtables.
+        Redo is buffered per tx and applied at its commit record, matching
+        commit-version visibility."""
+        pending: dict[int, list] = {}
+        max_ts = 0
+        for e in entries:
+            try:
+                rec = json.loads(e.payload.decode())
+            except Exception:
+                continue
+            op = rec.get("op")
+            if op == "redo":
+                pending.setdefault(rec["tx"], []).append(rec)
+            elif op == "commit":
+                version = rec["version"]
+                max_ts = max(max_ts, version)
+                for r in pending.pop(rec["tx"], []):
+                    ts = engine.tables.get(r["table"])
+                    if ts is None:
+                        continue
+                    key = tuple(r["key"])
+                    ts.tablet.write(key, r["kind"], r["values"], rec["tx"])
+                    ts.tablet.commit(rec["tx"], version, [key])
+            elif op == "abort":
+                pending.pop(rec["tx"], None)
+        return max_ts
+
+
+def _jsonable(values: dict) -> dict:
+    out = {}
+    for k, v in values.items():
+        if hasattr(v, "item"):
+            v = v.item()
+        out[k] = v
+    return out

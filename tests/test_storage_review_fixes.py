@@ -1,0 +1,129 @@
+"""Regression tests for the storage/tx code-review findings."""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.tx.errors import WriteConflict
+
+
+def test_checkpoint_during_active_tx_preserves_writes(tmp_path):
+    # finding 1: checkpoint while a tx is open must not lose its writes
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 10)")
+    s.execute("begin")
+    s.execute("update t set v = 77 where k = 1")
+    db.checkpoint()  # freezes + flushes mid-transaction
+    s.execute("commit")
+    assert s.execute("select v from t").rows() == [(77,)]
+    # and it survives a restart (WAL replay past the checkpoint)
+    db.close()
+    db2 = Database(str(tmp_path / "db"))
+    assert db2.session().execute("select v from t").rows() == [(77,)]
+    db2.close()
+
+
+def test_minor_compact_keeps_tombstones(tmp_path):
+    # finding 2: deleting a row whose base lives in L2, then minor-merging
+    # the L0s, must not resurrect the row
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 1), (2, 2)")
+    db.checkpoint()                      # L0 with both rows
+    db.engine.major_compact("t")         # -> L2 baseline
+    s.execute("delete from t where k = 1")
+    db.checkpoint()                      # L0 tombstone
+    s.execute("insert into t values (3, 3)")
+    db.checkpoint()                      # second L0
+    db.engine.minor_compact("t")         # merges only the L0s
+    r = s.execute("select k from t order by k")
+    assert r.rows() == [(2,), (3,)]      # k=1 must stay deleted
+    db.close()
+
+
+def test_major_compact_applies_tombstones_from_bulk_base(tmp_path):
+    # finding 3: bulk-loaded L2 lacks __deleted__; major merge must still
+    # honor tombstones from newer L0s
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    db.catalog.load_numpy("t2_bulk", {"k": np.arange(3), "v": np.arange(3)},
+                          primary_key=["k"])
+    s2 = db.session()
+    r = s2.execute("select count(*) from t2_bulk")
+    assert r.rows() == [(3,)]
+    s2.execute("delete from t2_bulk where k = 1")
+    db.checkpoint()
+    db.engine.major_compact("t2_bulk")
+    r = s2.execute("select k from t2_bulk order by k")
+    assert r.rows() == [(0,), (2,)]
+    db.close()
+
+
+def test_update_primary_key(tmp_path):
+    # finding 4: UPDATE that changes the PK must move the row, not clone it
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 100)")
+    s.execute("update t set k = 2 where k = 1")
+    r = s.execute("select k, v from t order by k")
+    assert r.rows() == [(2, 100)]
+    db.close()
+
+
+def test_keyless_rowid_after_wal_recovery(tmp_path):
+    # finding 5: rowid allocation must not collide with WAL-replayed rows
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table h (a int)")
+    s.execute("insert into h values (10), (20)")
+    db.close()  # crash: rows only in WAL
+    db2 = Database(root)
+    s2 = db2.session()
+    s2.execute("insert into h values (30)")
+    r = s2.execute("select a from h order by a")
+    assert r.rows() == [(10,), (20,), (30,)]
+    db2.close()
+
+
+def test_snapshot_isolation_across_flush(tmp_path):
+    # finding 6: a flush must not leak newer-committed rows into an older
+    # snapshot read
+    db = Database(str(tmp_path / "db"))
+    s1, s2 = db.session(), db.session()
+    s1.execute("create table t (k int primary key, v int)")
+    s1.execute("insert into t values (1, 100)")
+    s1.execute("begin")
+    assert s1.execute("select v from t").rows() == [(100,)]
+    s2.execute("update t set v = 200 where k = 1")  # newer commit
+    db.checkpoint()  # flush the v=200 version into a segment
+    # s1's snapshot must still see 100
+    assert s1.execute("select v from t").rows() == [(100,)]
+    s1.execute("commit")
+    assert s1.execute("select v from t").rows() == [(200,)]
+    db.close()
+
+
+def test_statement_rollback_in_explicit_tx(tmp_path):
+    # finding 7: a failed statement must not leave partial writes in the tx
+    db = Database(str(tmp_path / "db"))
+    s1, s2 = db.session(), db.session()
+    s1.execute("create table t (k int primary key, v int)")
+    s1.execute("insert into t values (5, 50)")
+    # s2 locks key 5
+    s2.execute("begin")
+    s2.execute("update t set v = 51 where k = 5")
+    # s1: multi-row insert hits the lock on (5,) after writing (4,)
+    s1.execute("begin")
+    with pytest.raises(WriteConflict):
+        s1.execute("insert into t values (4, 40), (5, 55)")
+    s2.execute("rollback")
+    s1.execute("commit")
+    r = s1.execute("select k, v from t order by k")
+    assert r.rows() == [(5, 50)]  # neither 4 nor 55 applied
+    db.close()
