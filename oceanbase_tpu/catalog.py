@@ -58,7 +58,34 @@ class Catalog:
         self._lock = threading.RLock()
         self._defs: dict[str, TableDef] = {}
         self._data: dict[str, Relation] = {}
+        # transient tables: materialized virtual (gv$/v$) relations,
+        # refreshed per statement (≙ virtual table iterators)
+        self._transients: dict[str, tuple] = {}
         self.schema_version = 1
+
+    def register_transient(self, name: str, arrays, types=None):
+        import jax.numpy as jnp
+
+        from oceanbase_tpu.vector import Relation, from_numpy
+
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        if n == 0:
+            # static shapes need capacity >= 1: one all-dead row
+            arrays = {k: (np.array([""], dtype=object)
+                          if np.asarray(v).dtype.kind in "OUS"
+                          else np.zeros(1, dtype=np.asarray(v).dtype))
+                      for k, v in arrays.items()}
+            rel = from_numpy(arrays, types=types)
+            rel = Relation(columns=rel.columns,
+                           mask=jnp.zeros(1, dtype=jnp.bool_))
+            row_count = 0
+        else:
+            rel = from_numpy(arrays, types=types)
+            row_count = rel.capacity
+        cols = [ColumnDef(c, rel.columns[c].dtype) for c in arrays]
+        tdef = TableDef(name, cols, row_count=max(row_count, 1))
+        with self._lock:
+            self._transients[name] = (tdef, rel)
 
     # -- DDL -------------------------------------------------------------
     def create_table(self, tdef: TableDef, if_not_exists: bool = False):
@@ -115,19 +142,25 @@ class Catalog:
     # -- lookup ----------------------------------------------------------
     def table_def(self, name: str) -> TableDef:
         with self._lock:
+            t = self._transients.get(name)
+            if t is not None:
+                return t[0]
             if name not in self._defs:
                 raise KeyError(f"unknown table {name}")
             return self._defs[name]
 
     def table_data(self, name: str) -> Relation:
         with self._lock:
+            t = self._transients.get(name)
+            if t is not None:
+                return t[1]
             if name not in self._data:
                 raise KeyError(f"table {name} has no data")
             return self._data[name]
 
     def has_table(self, name: str) -> bool:
         with self._lock:
-            return name in self._defs
+            return name in self._defs or name in self._transients
 
     def tables(self) -> list[str]:
         with self._lock:

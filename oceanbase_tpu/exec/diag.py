@@ -44,3 +44,30 @@ def push(name: str, scalar) -> None:
 class CapacityOverflow(RuntimeError):
     """Raised by the executor when an operator exceeded its static
     capacity; callers re-plan with a larger budget (spill in later rounds)."""
+
+
+# ---------------------------------------------------------------------------
+# per-operator monitor lane (≙ op_monitor_info_ row counts,
+# src/sql/engine/ob_operator.cpp:1534): operators report their live-row
+# output as traced scalars bundled into the compiled plan's outputs.
+# ---------------------------------------------------------------------------
+
+_monitor: contextvars.ContextVar[list | None] = contextvars.ContextVar(
+    "ob_tpu_monitor", default=None
+)
+
+
+@contextlib.contextmanager
+def monitor_collect():
+    entries: list[tuple[str, object]] = []
+    tok = _monitor.set(entries)
+    try:
+        yield entries
+    finally:
+        _monitor.reset(tok)
+
+
+def monitor_push(op_name: str, count_scalar) -> None:
+    entries = _monitor.get()
+    if entries is not None:
+        entries.append((op_name, count_scalar))

@@ -158,6 +158,13 @@ class Compact(PlanNode):
 
 
 def _lower(node: PlanNode, tables: dict[str, Relation]) -> Relation:
+    rel = _lower_inner(node, tables)
+    # per-operator row accounting (no-op unless a monitor is collecting)
+    diag.monitor_push(type(node).__name__, rel.count())
+    return rel
+
+
+def _lower_inner(node: PlanNode, tables: dict[str, Relation]) -> Relation:
     if isinstance(node, TableScan):
         rel = tables[node.table]
         if node.columns is not None:
@@ -212,19 +219,28 @@ def referenced_tables(node: PlanNode) -> set[str]:
 
 
 @functools.lru_cache(maxsize=256)
-def _compiled(plan_key, plan_holder):
+def _compiled(plan_key, plan_holder, with_monitor=False):
     plan = plan_holder.plan
-    diag_names: list[str] = []  # filled at trace time
+    diag_names: list[str] = []     # filled at trace time
+    monitor_names: list[str] = []
 
     @jax.jit
     def run(tables):
         with diag.collect() as entries:
-            out = _lower(plan, tables)
+            if with_monitor:
+                with diag.monitor_collect() as mons:
+                    out = _lower(plan, tables)
+                monitor_names.clear()
+                monitor_names.extend(n for n, _ in mons)
+                mvals = [v for _, v in mons]
+            else:
+                out = _lower(plan, tables)
+                mvals = []
         diag_names.clear()
         diag_names.extend(n for n, _ in entries)
-        return out, [v for _, v in entries]
+        return out, [v for _, v in entries], mvals
 
-    return run, diag_names
+    return run, diag_names, monitor_names
 
 
 class _PlanHolder:
@@ -243,7 +259,8 @@ class _PlanHolder:
 
 
 def execute_plan(plan: PlanNode, tables: dict[str, Relation],
-                 check_overflow: bool = True) -> Relation:
+                 check_overflow: bool = True,
+                 monitor_out: list | None = None) -> Relation:
     """Compile (cached) + run a plan against device tables.
 
     ≙ ObExecutor::execute_plan (src/sql/executor/ob_executor.cpp:37); the
@@ -256,8 +273,14 @@ def execute_plan(plan: PlanNode, tables: dict[str, Relation],
     """
     key = plan.fingerprint()
     needed = referenced_tables(plan)
-    run, diag_names = _compiled(key, _PlanHolder(plan, key))
-    out, diag_vals = run({k: v for k, v in tables.items() if k in needed})
+    with_monitor = monitor_out is not None
+    run, diag_names, monitor_names = _compiled(
+        key, _PlanHolder(plan, key), with_monitor)
+    out, diag_vals, mon_vals = run(
+        {k: v for k, v in tables.items() if k in needed})
+    if with_monitor:
+        monitor_out.extend(
+            (n, int(v)) for n, v in zip(monitor_names, mon_vals))
     if check_overflow and diag_vals:
         vals = [int(v) for v in diag_vals]
         if any(v > 0 for v in vals):

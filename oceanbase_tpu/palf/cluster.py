@@ -125,6 +125,9 @@ class PalfCluster:
     # ------------------------------------------------------------------
     def append(self, payloads: list[bytes]) -> int:
         """Group-append on the leader; returns committed end LSN."""
+        from oceanbase_tpu.server.errsim import ERRSIM
+
+        ERRSIM.hit("palf.append")
         with self._lock:
             ldr = self.leader()
             entries = ldr.leader_append(payloads)

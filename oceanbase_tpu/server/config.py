@@ -1,0 +1,199 @@
+"""Declarative configuration registry.
+
+Reference analog: the parameter seed file with DEF_INT/DEF_BOOL/DEF_CAP
+macros (src/share/parameter/ob_parameter_seed.ipp — 738 definitions) with
+checkers (src/share/config/ob_config_helper.h), runtime-settable via
+ALTER SYSTEM SET, persisted, with per-tenant overlays
+(src/observer/omt/ob_tenant_config_mgr.h).
+
+Same pattern here: one registry of typed, validated, documented parameters;
+hot-reloadable; persisted to the data directory; per-tenant overlay maps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+
+@dataclass
+class ParamDef:
+    name: str
+    default: Any
+    ptype: str             # int | bool | str | float | cap
+    doc: str
+    validator: Optional[Callable[[Any], bool]] = None
+    reboot_required: bool = False
+
+
+_DEFS: dict[str, ParamDef] = {}
+
+
+def DEF(name, default, ptype, doc, validator=None, reboot=False):
+    _DEFS[name] = ParamDef(name, default, ptype, doc, validator, reboot)
+    return name
+
+
+def _pos(v):
+    return v > 0
+
+
+def _nonneg(v):
+    return v >= 0
+
+
+def _frac(v):
+    return 0.0 <= v <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# parameter seed (≙ ob_parameter_seed.ipp) — the engine's knobs
+# ---------------------------------------------------------------------------
+
+# SQL engine
+DEF("max_batch_size", 65536, "int",
+    "row batch capacity per morsel on device (multiple of 8*128 lanes)",
+    _pos)
+DEF("default_group_capacity", 1 << 16, "int",
+    "default static capacity for GROUP BY outputs", _pos)
+DEF("join_capacity_factor", 1.5, "float",
+    "safety multiplier over join cardinality estimates", _pos)
+DEF("max_capacity_retry", 3, "int",
+    "re-plan attempts (4x budget each) after CapacityOverflow", _nonneg)
+DEF("enable_sql_plan_monitor", True, "bool",
+    "collect per-operator row counts/timings (≙ sql_plan_monitor)")
+DEF("enable_plan_cache", True, "bool",
+    "cache bound physical plans keyed by parameterized SQL text")
+DEF("plan_cache_mem_limit", 512 << 20, "cap",
+    "plan cache memory budget in bytes", _pos)
+DEF("query_timeout_s", 3600, "int", "per-statement timeout seconds", _pos)
+
+# PX / distributed
+DEF("px_default_dop", 0, "int",
+    "degree of parallelism (0 = mesh size)", _nonneg)
+DEF("px_exchange_capacity_per_dest", 1 << 20, "int",
+    "all_to_all per-destination row budget", _pos)
+DEF("px_workers_per_tenant", 64, "int",
+    "PX admission quota (≙ px_workers_per_cpu_quota)", _pos)
+
+# storage
+DEF("memstore_limit_rows", 1_000_000, "int",
+    "freeze threshold per tablet (rows in active memtable)", _pos)
+DEF("minor_compact_trigger", 4, "int",
+    "L0 segment count triggering minor compaction (≙ minor_compact_trigger)",
+    _pos)
+DEF("major_compaction_interval_s", 86400, "int",
+    "major merge cadence (≙ daily merge)", _pos)
+DEF("segment_chunk_rows", 65536, "int",
+    "rows per encoded chunk (micro-block analog)", _pos)
+DEF("enable_zone_map_pruning", True, "bool",
+    "skip chunks via min/max zone maps on range predicates")
+
+# WAL / replication
+DEF("wal_replica_count", 3, "int", "PALF replica count", _pos)
+DEF("palf_lease_ms", 400, "int", "election lease duration", _pos)
+DEF("log_checkpoint_interval_s", 60, "int",
+    "checkpoint cadence bounding WAL replay length", _pos)
+
+# tenants / resources
+DEF("tenant_cpu_quota", 4, "int", "worker threads per tenant unit", _pos)
+DEF("tenant_memory_limit", 4 << 30, "cap",
+    "per-tenant memory budget in bytes", _pos)
+DEF("enable_rate_limit", False, "bool",
+    "throttle writes on memstore pressure (≙ write throttling)")
+
+# diagnostics
+DEF("enable_ash", True, "bool",
+    "active-session-history sampling (≙ ASH)")
+DEF("ash_sample_interval_ms", 1000, "int", "ASH sampling period", _pos)
+DEF("sql_audit_queue_size", 10000, "int",
+    "ring-buffer capacity of gv$sql_audit", _pos)
+DEF("enable_defensive_check", True, "bool",
+    "extra engine invariant checks (≙ _enable_defensive_check)")
+
+
+class Config:
+    """One configuration instance (cluster-level or tenant overlay)."""
+
+    def __init__(self, persist_path: str | None = None,
+                 parent: "Config | None" = None):
+        self._values: dict[str, Any] = {}
+        self._parent = parent
+        self._persist_path = persist_path
+        self._lock = threading.RLock()
+        self._watchers: list[Callable[[str, Any], None]] = []
+        if persist_path and os.path.exists(persist_path):
+            with open(persist_path) as f:
+                stored = json.load(f)
+            for k, v in stored.items():
+                if k in _DEFS:
+                    self._values[k] = v
+
+    # ------------------------------------------------------------------
+    def get(self, name: str):
+        if name not in _DEFS:
+            raise KeyError(f"unknown parameter {name!r}")
+        with self._lock:
+            if name in self._values:
+                return self._values[name]
+        if self._parent is not None:
+            return self._parent.get(name)
+        return _DEFS[name].default
+
+    def __getitem__(self, name):
+        return self.get(name)
+
+    def set(self, name: str, value):
+        """Runtime update with type coercion + validation
+        (≙ ALTER SYSTEM SET)."""
+        d = _DEFS.get(name)
+        if d is None:
+            raise KeyError(f"unknown parameter {name!r}")
+        value = _coerce(d.ptype, value)
+        if d.validator is not None and not d.validator(value):
+            raise ValueError(f"invalid value {value!r} for {name}")
+        with self._lock:
+            self._values[name] = value
+            if self._persist_path:
+                tmp = self._persist_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(self._values, f, indent=1)
+                os.replace(tmp, self._persist_path)
+            watchers = list(self._watchers)
+        for w in watchers:
+            w(name, value)
+
+    def watch(self, fn: Callable[[str, Any], None]):
+        self._watchers.append(fn)
+
+    def snapshot(self) -> dict:
+        out = {}
+        for name, d in sorted(_DEFS.items()):
+            out[name] = self.get(name)
+        return out
+
+    @staticmethod
+    def defs() -> dict[str, ParamDef]:
+        return dict(_DEFS)
+
+
+_CAP_UNITS = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+def _coerce(ptype: str, v):
+    if ptype == "int":
+        return int(v)
+    if ptype == "float":
+        return float(v)
+    if ptype == "bool":
+        if isinstance(v, str):
+            return v.lower() in ("1", "true", "on", "yes")
+        return bool(v)
+    if ptype == "cap":
+        if isinstance(v, str) and v and v[-1].lower() in _CAP_UNITS:
+            return int(float(v[:-1]) * _CAP_UNITS[v[-1].lower()])
+        return int(v)
+    return str(v)

@@ -1,70 +1,129 @@
-"""Database: boot/recovery wiring of storage + WAL + tx + catalog.
+"""Database: the server instance — tenants, config, observability.
 
-Reference analog: ObServer::init/start (src/observer/ob_server.cpp:228) —
-config load, storage meta replay (slog checkpoint), palf restart, replay
-service catch-up — collapsed to the single-node single-tenant boot:
+Reference analog: ObServer::init/start (src/observer/ob_server.cpp:228)
+booting config, network frame, multi-tenant env, storage meta replay and
+log replay — collapsed to the in-process instance:
 
-    manifest/segments load -> WAL (palf) recovery -> replay committed
-    records newer than the checkpoint into memtables -> GTS re-seeded.
+- cluster Config (persisted) + per-tenant overlays
+- tenants, each owning the full module stack (see server/tenant.py);
+  tenant 'sys' always exists (≙ the sys tenant)
+- observability singletons: SQL audit ring, plan monitor, ASH sampler,
+  wait events, virtual tables (gv$/v$ served through SQL)
 
-``Database.session()`` hands out SQL sessions bound to this instance
-(≙ MySQL frontend connections).
+``Database.session(tenant=...)`` hands out SQL sessions
+(≙ MySQL frontend connections landing in a tenant's queue).
 """
 
 from __future__ import annotations
 
+import itertools
 import os
 from typing import Optional
 
-from oceanbase_tpu.palf.cluster import PalfCluster
-from oceanbase_tpu.storage.engine import StorageCatalog, StorageEngine
-from oceanbase_tpu.tx.service import TransService
+from oceanbase_tpu.server.config import Config
+from oceanbase_tpu.server.monitor import (
+    AshSampler,
+    PlanMonitor,
+    SqlAudit,
+    WaitEvents,
+)
+from oceanbase_tpu.server.tenant import Tenant
+from oceanbase_tpu.server.virtual_tables import VirtualTables
 
 
 class Database:
-    def __init__(self, root: str | None = None, wal_replicas: int = 3):
-        data_dir = os.path.join(root, "data") if root else None
-        wal_dir = os.path.join(root, "wal") if root else None
-        if wal_dir:
-            os.makedirs(wal_dir, exist_ok=True)
-        self.engine = StorageEngine(data_dir)
-        self.wal = PalfCluster(wal_replicas, log_root=wal_dir)
-        self.wal.elect()
-        self.tx = TransService(wal=self.wal)
+    def __init__(self, root: str | None = None, wal_replicas: int = 3,
+                 start_ash: bool = False):
+        self.root = root
+        cfg_path = os.path.join(root, "config.json") if root else None
+        if root:
+            os.makedirs(root, exist_ok=True)
+        self.config = Config(persist_path=cfg_path)
+        self.tenants: dict[str, Tenant] = {}
+        self._session_ids = itertools.count(1)
 
-        # replay committed WAL newer than the storage checkpoint
-        ldr = self.wal.replicas[self.wal.leader_id]
-        start = self.engine.meta.get("wal_lsn", 0)
-        committed = ldr.committed_lsn
-        if committed > start:
-            max_ts = TransService.replay(
-                ldr.entries[start:committed], self.engine)
-            self.tx.gts.advance_to(max_ts)
-        self.tx.gts.advance_to(self.engine.meta.get("gts", 0))
+        # observability (cluster-wide)
+        self.audit = SqlAudit(int(self.config["sql_audit_queue_size"]))
+        self.plan_monitor = PlanMonitor()
+        self.ash = AshSampler(
+            interval_s=int(self.config["ash_sample_interval_ms"]) / 1000.0)
+        self.wait_events = WaitEvents()
+        self.virtual_tables = VirtualTables(self)
+        if start_ash and self.config["enable_ash"]:
+            self.ash.start()
 
-        self.catalog = StorageCatalog(
-            self.engine, snapshot_fn=self.tx.gts.current)
-
-    def session(self):
-        from oceanbase_tpu.sql.session import Session
-
-        return Session(self.catalog, db=self)
+        # boot tenants: 'sys' plus any persisted tenant directories
+        self.create_tenant("sys", wal_replicas=wal_replicas, _boot=True)
+        if root:
+            tdir = os.path.join(root, "tenants")
+            if os.path.isdir(tdir):
+                for name in sorted(os.listdir(tdir)):
+                    if name != "sys" and name not in self.tenants and \
+                            os.path.isdir(os.path.join(tdir, name)):
+                        self.create_tenant(name, wal_replicas=wal_replicas,
+                                           _boot=True)
 
     # ------------------------------------------------------------------
-    def checkpoint(self):
-        """Freeze+flush all tables, then checkpoint storage meta recording
-        the WAL replay point (≙ clog checkpoint advancing so logs recycle)."""
-        snap = self.tx.gts.current()
-        for name in list(self.engine.tables):
-            self.engine.freeze_and_flush(name, snapshot=snap)
-        replay_point = self.wal.committed_lsn()
-        oldest_live = self.tx.min_active_wal_lsn()
-        if oldest_live is not None:
-            # live transactions' redo must survive for crash recovery
-            replay_point = min(replay_point, oldest_live - 1)
-        self.engine.meta["wal_lsn"] = replay_point
-        self.engine.meta["gts"] = self.tx.gts.current()
-        self.engine.checkpoint()
+    def create_tenant(self, name: str, wal_replicas: int = 3,
+                      _boot: bool = False) -> Tenant:
+        if name in self.tenants:
+            if _boot:
+                return self.tenants[name]
+            raise ValueError(f"tenant {name} exists")
+        troot = (os.path.join(self.root, "tenants", name)
+                 if self.root else None)
+        if troot:
+            os.makedirs(troot, exist_ok=True)
+        t = Tenant(name, troot, self.config, wal_replicas=wal_replicas)
+        self.tenants[name] = t
+        return t
+
+    def drop_tenant(self, name: str):
+        if name == "sys":
+            raise ValueError("cannot drop sys tenant")
+        t = self.tenants.pop(name, None)
+        if t is not None:
+            t.close()
+        if self.root:
+            import shutil
+
+            troot = os.path.join(self.root, "tenants", name)
+            if os.path.isdir(troot):
+                shutil.rmtree(troot, ignore_errors=True)
+
+    def tenant(self, name: str = "sys") -> Tenant:
+        return self.tenants[name]
+
+    # -- sys-tenant convenience (single-tenant callers) ------------------
+    @property
+    def engine(self):
+        return self.tenants["sys"].engine
+
+    @property
+    def wal(self):
+        return self.tenants["sys"].wal
+
+    @property
+    def tx(self):
+        return self.tenants["sys"].tx
+
+    @property
+    def catalog(self):
+        return self.tenants["sys"].catalog
+
+    # ------------------------------------------------------------------
+    def session(self, tenant: str = "sys"):
+        from oceanbase_tpu.sql.session import Session
+
+        t = self.tenants[tenant]
+        return Session(t.catalog, tenant=t, db=self)
+
+    def checkpoint(self, tenant: str | None = None):
+        for name, t in self.tenants.items():
+            if tenant is None or name == tenant:
+                t.checkpoint()
 
     def close(self):
-        self.wal.close()
+        self.ash.stop()
+        for t in self.tenants.values():
+            t.close()

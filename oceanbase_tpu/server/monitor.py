@@ -1,0 +1,144 @@
+"""Observability: plan monitor, SQL audit, ASH sampling, wait events.
+
+Reference analogs (SURVEY §5.1/§5.5):
+- per-operator plan monitor  ≙ op_monitor_info_ + sql_plan_monitor
+  (src/sql/engine/ob_operator.cpp:1534,
+  src/share/diagnosis/ob_sql_plan_monitor_node_list.h)
+- SQL audit ring buffer      ≙ ObMySQLRequestManager -> gv$sql_audit
+  (src/observer/mysql/ob_mysql_request_manager.h:66)
+- ASH                        ≙ active session history sampling
+  (src/share/ash/ob_active_sess_hist_task.h)
+- wait-event counters        ≙ deps/oblib/src/lib/stat
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class AuditRecord:
+    """One executed request (≙ one gv$sql_audit row)."""
+
+    sql: str
+    session_id: int
+    tenant: str
+    start_ts: float
+    elapsed_s: float
+    rows: int
+    plan_hash: str = ""
+    error: str = ""
+    compile_s: float = 0.0
+
+
+class SqlAudit:
+    """Fixed-capacity ring of recent requests."""
+
+    def __init__(self, capacity: int = 10000):
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, rec: AuditRecord):
+        with self._lock:
+            self._ring.append(rec)
+
+    def recent(self, n: int = 100) -> list:
+        with self._lock:
+            return list(self._ring)[-n:]
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+
+class PlanMonitor:
+    """Plan-level + per-operator stats for recent executions."""
+
+    def __init__(self, capacity: int = 1000):
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, plan_hash: str, op_stats: list, total_s: float):
+        with self._lock:
+            self._ring.append((time.time(), plan_hash, op_stats, total_s))
+
+    def recent(self, n: int = 50):
+        with self._lock:
+            return list(self._ring)[-n:]
+
+
+class WaitEvents:
+    """Named counters/timers (≙ wait-event instrumentation)."""
+
+    def __init__(self):
+        self._counts: collections.Counter = collections.Counter()
+        self._times: collections.defaultdict = collections.defaultdict(float)
+        self._lock = threading.Lock()
+
+    def add(self, event: str, seconds: float = 0.0):
+        with self._lock:
+            self._counts[event] += 1
+            self._times[event] += seconds
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {e: (self._counts[e], self._times[e])
+                    for e in self._counts}
+
+
+class AshSampler:
+    """Periodic sampler of live session states (≙ ASH task).
+
+    Sessions register a mutable state slot; the sampler snapshots every
+    interval into a bounded history.
+    """
+
+    def __init__(self, interval_s: float = 1.0, capacity: int = 36000):
+        self.interval_s = interval_s
+        self._sessions: dict[int, dict] = {}
+        self._history: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def register(self, session_id: int, state: dict):
+        with self._lock:
+            self._sessions[session_id] = state
+
+    def unregister(self, session_id: int):
+        with self._lock:
+            self._sessions.pop(session_id, None)
+
+    def sample_once(self):
+        now = time.time()
+        with self._lock:
+            for sid, st in self._sessions.items():
+                if st.get("active"):
+                    self._history.append(
+                        (now, sid, st.get("sql", ""), st.get("state", "")))
+
+    def history(self, n: int = 100):
+        with self._lock:
+            return list(self._history)[-n:]
+
+    def start(self):
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.sample_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="ash-sampler")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

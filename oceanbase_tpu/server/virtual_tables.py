@@ -1,0 +1,163 @@
+"""Virtual tables: observability served through SQL.
+
+Reference analog: the __all_virtual_* tables + GV$ views
+(src/observer/virtual_table, generated schemas src/share/inner_table) —
+the reference's observability surface IS SQL; same here.
+
+Each provider returns {column -> numpy array}; Session materializes them
+as transient catalog tables on reference, so
+
+    SELECT * FROM gv$sql_audit ORDER BY elapsed_s DESC LIMIT 5
+
+works like any query.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def _obj(xs):
+    return np.array(list(xs), dtype=object)
+
+
+class VirtualTables:
+    def __init__(self, database):
+        self.db = database
+
+    def names(self):
+        return {
+            "gv$sql_audit": self.sql_audit,
+            "gv$plan_monitor": self.plan_monitor,
+            "v$session_history": self.session_history,
+            "v$parameters": self.parameters,
+            "v$tenants": self.tenants,
+            "v$tables": self.tables,
+            "v$palf": self.palf,
+            "v$wait_events": self.wait_events,
+            "v$errsim": self.errsim,
+        }
+
+    def provide(self, name: str):
+        fn = self.names().get(name)
+        return None if fn is None else fn()
+
+    # ------------------------------------------------------------------
+    def sql_audit(self):
+        recs = self.db.audit.recent(10000)
+        return {
+            "sql": _obj(r.sql[:200] for r in recs),
+            "session_id": np.array([r.session_id for r in recs], np.int64),
+            "tenant": _obj(r.tenant for r in recs),
+            "start_ts": np.array([r.start_ts for r in recs], np.float64),
+            "elapsed_s": np.array([r.elapsed_s for r in recs], np.float64),
+            "compile_s": np.array([r.compile_s for r in recs], np.float64),
+            "rows_returned": np.array([r.rows for r in recs], np.int64),
+            "error": _obj(r.error for r in recs),
+        }
+
+    def plan_monitor(self):
+        rows = []
+        for ts, phash, op_stats, total_s in self.db.plan_monitor.recent(200):
+            for op, cnt in op_stats:
+                rows.append((ts, phash, op, cnt, total_s))
+        return {
+            "ts": np.array([r[0] for r in rows], np.float64),
+            "plan_hash": _obj(r[1] for r in rows),
+            "operator": _obj(r[2] for r in rows),
+            "output_rows": np.array([r[3] for r in rows], np.int64),
+            "plan_elapsed_s": np.array([r[4] for r in rows], np.float64),
+        }
+
+    def session_history(self):
+        h = self.db.ash.history(10000)
+        return {
+            "sample_ts": np.array([x[0] for x in h], np.float64),
+            "session_id": np.array([x[1] for x in h], np.int64),
+            "sql": _obj(x[2][:200] for x in h),
+            "state": _obj(x[3] for x in h),
+        }
+
+    def parameters(self):
+        snap = self.db.config.snapshot()
+        defs = self.db.config.defs()
+        return {
+            "name": _obj(snap.keys()),
+            "value": _obj(str(v) for v in snap.values()),
+            "default_value": _obj(str(defs[k].default) for k in snap),
+            "type": _obj(defs[k].ptype for k in snap),
+            "info": _obj(defs[k].doc for k in snap),
+        }
+
+    def tenants(self):
+        ts = self.db.tenants
+        return {
+            "tenant": _obj(ts.keys()),
+            "tables": np.array([len(t.engine.tables) for t in ts.values()],
+                               np.int64),
+            "gts": np.array([t.tx.gts.current() for t in ts.values()],
+                            np.int64),
+            "wal_committed_lsn": np.array(
+                [t.wal.committed_lsn() for t in ts.values()], np.int64),
+        }
+
+    def tables(self):
+        rows = []
+        for tname, tenant in self.db.tenants.items():
+            for name, ts in tenant.engine.tables.items():
+                tab = ts.tablet
+                rows.append((tname, name, tab.row_count_estimate(),
+                             len(tab.segments),
+                             sum(s.nbytes() for s in tab.segments),
+                             len(tab.active) + sum(len(m)
+                                                   for m in tab.frozen)))
+        return {
+            "tenant": _obj(r[0] for r in rows),
+            "table_name": _obj(r[1] for r in rows),
+            "row_count": np.array([r[2] for r in rows], np.int64),
+            "segment_count": np.array([r[3] for r in rows], np.int64),
+            "segment_bytes": np.array([r[4] for r in rows], np.int64),
+            "memtable_rows": np.array([r[5] for r in rows], np.int64),
+        }
+
+    def palf(self):
+        rows = []
+        for tname, tenant in self.db.tenants.items():
+            for rid, r in tenant.wal.replicas.items():
+                rows.append((tname, rid, r.role, r.current_term,
+                             r.last_lsn(), r.committed_lsn,
+                             rid in tenant.wal.down))
+        return {
+            "tenant": _obj(r[0] for r in rows),
+            "replica_id": np.array([r[1] for r in rows], np.int64),
+            "role": _obj(r[2] for r in rows),
+            "term": np.array([r[3] for r in rows], np.int64),
+            "last_lsn": np.array([r[4] for r in rows], np.int64),
+            "committed_lsn": np.array([r[5] for r in rows], np.int64),
+            "is_down": np.array([bool(r[6]) for r in rows]),
+        }
+
+    def wait_events(self):
+        snap = self.db.wait_events.snapshot()
+        return {
+            "event": _obj(snap.keys()),
+            "total_waits": np.array([c for c, _ in snap.values()], np.int64),
+            "time_waited_s": np.array([t for _, t in snap.values()],
+                                      np.float64),
+        }
+
+    def errsim(self):
+        from oceanbase_tpu.server.errsim import ERRSIM
+
+        stats = ERRSIM.stats()
+        names = sorted(ERRSIM.registered | set(stats))
+        return {
+            "tracepoint": _obj(names),
+            "hits": np.array([stats.get(n, (0, 0))[0] for n in names],
+                             np.int64),
+            "fired": np.array([stats.get(n, (0, 0))[1] for n in names],
+                              np.int64),
+            "armed": np.array([n in stats for n in names]),
+        }

@@ -167,3 +167,28 @@ class TxStmt:
 @dataclass
 class AnalyzeStmt:
     table: str
+
+
+@dataclass
+class SetVarStmt:
+    scope: str   # session | global
+    name: str
+    value: object
+
+
+@dataclass
+class AlterSystemStmt:
+    action: str            # set | major_freeze | minor_freeze | checkpoint
+    name: Optional[str] = None
+    value: object = None
+
+
+@dataclass
+class TenantStmt:
+    op: str      # create | drop
+    name: str = ""
+
+
+@dataclass
+class ShowStmt:
+    what: str    # variables | parameters

@@ -22,6 +22,8 @@ KEYWORDS = {
     "index", "substring", "substr", "extract", "year", "month", "day",
     "any", "some", "if", "analyze", "show", "tables", "describe", "begin",
     "commit", "rollback", "using", "natural", "recursive", "for",
+    "alter", "system", "global", "session", "tenant", "freeze", "major",
+    "minor", "variables", "parameters",
 }
 
 TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||"}
@@ -108,7 +110,7 @@ def tokenize(sql: str) -> list[Token]:
             continue
         if c.isalpha() or c == "_":
             j = i
-            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+            while j < n and (sql[j].isalnum() or sql[j] in "_$"):
                 j += 1
             word = sql[i:j]
             lw = word.lower()

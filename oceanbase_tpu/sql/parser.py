@@ -23,6 +23,14 @@ class ParseError(ValueError):
     pass
 
 
+# keywords that may still appear as identifiers in expression position
+_SOFT_KEYWORDS = {
+    "tenant", "system", "global", "session", "freeze", "major", "minor",
+    "variables", "parameters", "tables", "values", "key", "index", "if",
+    "any", "some", "begin", "commit", "rollback", "show", "analyze",
+}
+
+
 @dataclass(eq=False)
 class Interval(ir.Expr):
     """INTERVAL 'n' unit — folded by the resolver into date arithmetic."""
@@ -96,9 +104,21 @@ class Parser:
         if self.at_op("("):
             return self.parse_select()
         if self.at_kw("create"):
+            if self.peek(1).kind == "kw" and self.peek(1).value == "tenant":
+                self.next()
+                self.next()
+                return ast.TenantStmt("create", self.expect_ident())
             return self.parse_create()
         if self.at_kw("drop"):
+            if self.peek(1).kind == "kw" and self.peek(1).value == "tenant":
+                self.next()
+                self.next()
+                return ast.TenantStmt("drop", self.expect_ident())
             return self.parse_drop()
+        if self.at_kw("set"):
+            return self.parse_set()
+        if self.at_kw("alter"):
+            return self.parse_alter_system()
         if self.at_kw("insert"):
             return self.parse_insert()
         if self.at_kw("update"):
@@ -107,6 +127,10 @@ class Parser:
             return self.parse_delete()
         if self.at_kw("show"):
             self.next()
+            if self.accept_kw("variables"):
+                return ast.ShowStmt("variables")
+            if self.accept_kw("parameters"):
+                return ast.ShowStmt("parameters")
             self.expect_kw("tables")
             return ast.ShowTablesStmt()
         if self.at_kw("describe"):
@@ -551,7 +575,17 @@ class Parser:
             return ir.ColumnRef(unit)
         if self.at_kw("exists"):
             return self.parse_predicate()
+        # non-reserved ("soft") keywords usable as identifiers in
+        # expression position (≙ MySQL non-reserved words)
         t = self.peek()
+        if t.value in _SOFT_KEYWORDS:
+            name = self.next().value
+            if self.at_op("("):
+                return self.parse_func_call(name)
+            if self.accept_op("."):
+                col = self.expect_ident()
+                return ir.ColumnRef(f"{name}.{col}")
+            return ir.ColumnRef(name)
         raise ParseError(f"unexpected keyword {t.value!r} at {t.pos}")
 
     def parse_case(self) -> ir.Expr:
@@ -624,6 +658,47 @@ class Parser:
         if name in ("boolean", "bool"):
             return SqlType.bool_()
         raise ParseError(f"unknown type {name!r} at {t.pos}")
+
+    def _literal_value(self):
+        t = self.next()
+        if t.kind == "number":
+            return float(t.value) if "." in t.value else int(t.value)
+        if t.kind == "string":
+            return t.value
+        if t.kind == "kw" and t.value in ("true", "false"):
+            return t.value == "true"
+        if t.kind == "ident":
+            return t.value
+        raise ParseError(f"expected literal at {t.pos}")
+
+    def parse_set(self):
+        self.expect_kw("set")
+        scope = "session"
+        if self.accept_kw("global"):
+            scope = "global"
+        else:
+            self.accept_kw("session")
+        name = self.expect_ident()
+        self.expect_op("=")
+        return ast.SetVarStmt(scope, name, self._literal_value())
+
+    def parse_alter_system(self):
+        self.expect_kw("alter")
+        self.expect_kw("system")
+        if self.accept_kw("set"):
+            name = self.expect_ident()
+            self.expect_op("=")
+            return ast.AlterSystemStmt("set", name, self._literal_value())
+        if self.accept_kw("major"):
+            self.expect_kw("freeze")
+            return ast.AlterSystemStmt("major_freeze")
+        if self.accept_kw("minor"):
+            self.expect_kw("freeze")
+            return ast.AlterSystemStmt("minor_freeze")
+        if self.accept_kw("freeze"):
+            return ast.AlterSystemStmt("minor_freeze")
+        t = self.peek()
+        raise ParseError(f"unsupported ALTER SYSTEM at {t.pos}")
 
     def parse_create(self):
         self.expect_kw("create")

@@ -77,18 +77,126 @@ class Session:
 
     def __init__(self, catalog: Catalog | None = None, tenant=None, db=None):
         self.catalog = catalog if catalog is not None else Catalog()
-        self.tenant = tenant
+        self.tenant = tenant  # server.Tenant when multi-tenant
         self.db = db  # server.Database when backed by the storage/tx plane
+        self.session_id = 0
         self.variables: dict[str, object] = {
             "autocommit": 1, "max_capacity_retry": self.MAX_CAPACITY_RETRIES,
         }
         self.plan_cache: dict[str, tuple] = {}
         self._tx = None  # active explicit transaction (BEGIN ... COMMIT)
+        self._ash_state = {"active": False, "sql": "", "state": "idle"}
+        if db is not None:
+            self.session_id = next(db._session_ids)
+            if getattr(db, "ash", None) is not None:
+                db.ash.register(self.session_id, self._ash_state)
+
+    def close(self):
+        """Release session resources (ASH slot, open transaction)."""
+        if self._tx is not None and self.db is not None:
+            self._txsvc.rollback(self._tx)
+            self._tx = None
+        if self.db is not None and getattr(self.db, "ash", None) is not None:
+            self.db.ash.unregister(self.session_id)
+
+    # tenant-scoped module stack (falls back to the db's sys tenant)
+    @property
+    def _txsvc(self):
+        if self.tenant is not None:
+            return self.tenant.tx
+        return self.db.tx
+
+    @property
+    def _engine(self):
+        if self.tenant is not None:
+            return self.tenant.engine
+        return self.db.engine
 
     # ------------------------------------------------------------------
     def execute(self, sql: str, params: list | None = None) -> Result:
-        stmt = parse_sql(sql)
-        return self.execute_stmt(stmt, params)
+        """Parse + execute one statement, with request auditing and ASH
+        state (≙ obmp_query process + sql_audit recording)."""
+        start = time.time()
+        err = ""
+        out = None
+        self._ash_state.update(active=True, sql=sql, state="executing")
+        try:
+            stmt = parse_sql(sql)
+            self._materialize_virtuals(stmt)
+            out = self.execute_stmt(stmt, params)
+            return out
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            self._ash_state.update(active=False, state="idle")
+            if self.db is not None and \
+                    getattr(self.db, "audit", None) is not None:
+                from oceanbase_tpu.server.monitor import AuditRecord
+
+                self.db.audit.record(AuditRecord(
+                    sql=sql, session_id=self.session_id,
+                    tenant=getattr(self.tenant, "name", ""),
+                    start_ts=start, elapsed_s=time.time() - start,
+                    rows=out.rowcount if out is not None else 0,
+                    error=err,
+                ))
+
+    def _materialize_virtuals(self, stmt):
+        """Refresh any referenced gv$/v$ virtual tables as transient
+        catalog relations (≙ virtual table iterators serving the query).
+        Covers every statement shape that can reference a table: SELECT
+        (FROM, CTEs, set ops, expression subqueries), EXPLAIN,
+        INSERT ... SELECT, UPDATE/DELETE WHERE subqueries."""
+        if self.db is None:
+            return
+        vt = getattr(self.db, "virtual_tables", None)
+        if vt is None:
+            return
+
+        def refresh(name):
+            arrays = vt.provide(name)
+            if arrays is not None:
+                self.catalog.register_transient(name, arrays)
+
+        def walk_expr(e):
+            if e is None or not isinstance(e, ir.Expr):
+                return
+            if isinstance(e, ast.Subquery) and e.select is not None:
+                walk_sel(e.select)
+            for c in e.children():
+                walk_expr(c)
+
+        def walk_from(items):
+            for t in items:
+                if isinstance(t, ast.TableRef):
+                    refresh(t.name)
+                elif isinstance(t, ast.JoinRef):
+                    walk_from([t.left, t.right])
+                    if isinstance(t.on, ir.Expr):
+                        walk_expr(t.on)
+                elif isinstance(t, ast.SubqueryRef):
+                    walk_sel(t.select)
+
+        def walk_sel(s):
+            walk_from(s.from_)
+            for e, _ in s.items:
+                walk_expr(e)
+            walk_expr(s.where)
+            walk_expr(s.having)
+            for _, sub in s.ctes:
+                walk_sel(sub)
+            for _, _, rhs in s.setops:
+                walk_sel(rhs)
+
+        if isinstance(stmt, ast.ExplainStmt):
+            stmt = stmt.stmt
+        if isinstance(stmt, ast.SelectStmt):
+            walk_sel(stmt)
+        elif isinstance(stmt, ast.InsertStmt) and stmt.select is not None:
+            walk_sel(stmt.select)
+        elif isinstance(stmt, (ast.UpdateStmt, ast.DeleteStmt)):
+            walk_expr(stmt.where)
 
     def execute_stmt(self, stmt, params=None) -> Result:
         if isinstance(stmt, ast.SelectStmt):
@@ -124,10 +232,92 @@ class Session:
                                   for c in td.columns], dtype=object)},
                 {}, {}, rowcount=len(td.columns))
         if isinstance(stmt, ast.AnalyzeStmt):
-            return _ok()
+            return self._analyze(stmt)
         if isinstance(stmt, ast.TxStmt):
             return self._tx_control(stmt.op)
+        if isinstance(stmt, ast.SetVarStmt):
+            return self._set_var(stmt)
+        if isinstance(stmt, ast.AlterSystemStmt):
+            return self._alter_system(stmt)
+        if isinstance(stmt, ast.TenantStmt):
+            if self.db is None:
+                raise NotImplementedError("tenants need a Database")
+            if stmt.op == "create":
+                self.db.create_tenant(stmt.name)
+            else:
+                self.db.drop_tenant(stmt.name)
+            return _ok()
+        if isinstance(stmt, ast.ShowStmt):
+            if stmt.what == "variables":
+                names = sorted(self.variables)
+                return Result(
+                    ["variable_name", "value"],
+                    {"variable_name": np.array(names, dtype=object),
+                     "value": np.array([str(self.variables[n])
+                                        for n in names], dtype=object)},
+                    {}, {}, rowcount=len(names))
+            cfg = (self.tenant.config if self.tenant is not None
+                   else self.db.config if self.db else None)
+            if cfg is None:
+                return _ok()
+            snap = cfg.snapshot()
+            return Result(
+                ["name", "value"],
+                {"name": np.array(list(snap), dtype=object),
+                 "value": np.array([str(v) for v in snap.values()],
+                                   dtype=object)},
+                {}, {}, rowcount=len(snap))
         raise NotImplementedError(type(stmt).__name__)
+
+    def _set_var(self, stmt: ast.SetVarStmt) -> Result:
+        if stmt.scope == "global":
+            cfg = (self.tenant.config if self.tenant is not None
+                   else self.db.config if self.db else None)
+            if cfg is None:
+                raise ValueError("no global config available")
+            cfg.set(stmt.name, stmt.value)
+        else:
+            self.variables[stmt.name] = stmt.value
+        return _ok()
+
+    def _alter_system(self, stmt: ast.AlterSystemStmt) -> Result:
+        if stmt.action == "set":
+            cfg = self.db.config if self.db is not None else None
+            if cfg is None:
+                raise ValueError("ALTER SYSTEM needs a Database")
+            cfg.set(stmt.name, stmt.value)
+            return _ok()
+        if self.db is None:
+            raise ValueError("ALTER SYSTEM needs a Database")
+        eng = self._engine
+        snap = self._txsvc.gts.current()
+        for name in list(eng.tables):
+            eng.freeze_and_flush(name, snapshot=snap)
+            if stmt.action == "major_freeze":
+                eng.major_compact(name)
+            self.catalog.invalidate(name)
+        return _ok()
+
+    def _analyze(self, stmt: ast.AnalyzeStmt) -> Result:
+        """Refresh optimizer stats (row counts + NDV) for a table
+        (≙ DBMS_STATS gather, src/share/stat)."""
+        td = self.catalog.table_def(stmt.table)
+        rel = self.catalog.table_data(stmt.table)
+        import numpy as _np
+
+        mask = _np.asarray(rel.mask_or_true())
+        n = int(mask.sum())
+        td.row_count = n
+        for c in td.columns:
+            col = rel.columns.get(c.name)
+            if col is None:
+                continue
+            if col.sdict is not None:
+                td.ndv[c.name] = col.sdict.size
+            else:
+                data = _np.asarray(col.data)[mask]
+                td.ndv[c.name] = int(len(_np.unique(data))) if n else 1
+        return _ok()
 
     # ------------------------------------------------------------------
     def _plan_select(self, stmt: ast.SelectStmt, params):
@@ -150,16 +340,28 @@ class Session:
         tables = {t: self._table_snapshot(t)
                   for t in referenced_tables(plan)
                   if self.catalog.has_table(t)}
+        monitor = None
+        if self.db is not None and \
+                getattr(self.db, "plan_monitor", None) is not None and \
+                self.db.config["enable_sql_plan_monitor"]:
+            monitor = []
         factor = 1
+        t0 = time.time()
         for attempt in range(int(self.variables["max_capacity_retry"]) + 1):
             try:
                 p = plan if factor == 1 else scale_capacities(plan, factor)
-                rel = execute_plan(p, tables)
+                rel = execute_plan(p, tables, monitor_out=monitor)
                 break
             except CapacityOverflow:
                 if attempt >= int(self.variables["max_capacity_retry"]):
                     raise
                 factor *= 4
+                if monitor is not None:
+                    monitor.clear()
+        if monitor is not None:
+            self.db.plan_monitor.record(
+                plan.fingerprint()[:64] if hasattr(plan, "fingerprint")
+                else "", monitor, time.time() - t0)
         return self._materialize(rel, outputs)
 
     def _materialize(self, rel: Relation, outputs) -> Result:
@@ -238,15 +440,20 @@ class Session:
                     new = p.keys[writes_before.get(t, 0):]
                     if new:
                         stmt_writes[t] = new
-                self.db.tx.rollback_statement(tx, seq, stmt_writes)
+                self._txsvc.rollback_statement(tx, seq, stmt_writes)
                 raise
-        tx = self.db.tx.begin()
+        tx = self._txsvc.begin()
         try:
             out = fn(tx)
         except Exception:
-            self.db.tx.rollback(tx)
+            self._txsvc.rollback(tx)
             raise
-        self.db.tx.commit(tx)
+        try:
+            self._txsvc.commit(tx)
+        except Exception:
+            # a failed commit aborts the transaction (locks released)
+            self._txsvc.rollback(tx)
+            raise
         return out
 
     def _insert_tx(self, stmt: ast.InsertStmt, params) -> Result:
@@ -279,12 +486,12 @@ class Session:
                 for c in td.columns:
                     values.setdefault(c.name, None)
                 rows_values.append(values)
-        tablet = self.db.engine.tables[stmt.table].tablet
+        tablet = self._engine.tables[stmt.table].tablet
 
         def op(tx):
             for values in rows_values:
                 key = tablet.make_key(values)
-                self.db.tx.write(tx, stmt.table, tablet, key, "insert",
+                self._txsvc.write(tx, stmt.table, tablet, key, "insert",
                                  values)
 
         self._run_in_tx(op)
@@ -296,9 +503,9 @@ class Session:
         from oceanbase_tpu.expr.compile import eval_predicate
         from oceanbase_tpu.sql.binder import Binder, Scope
 
-        tablet = self.db.engine.tables[table].tablet
+        tablet = self._engine.tables[table].tablet
         snap = (self._tx.snapshot if self._tx is not None
-                else self.db.tx.gts.current())
+                else self._txsvc.gts.current())
         tx_id = self._tx.tx_id if self._tx is not None else 0
         rel = self.catalog.table_data_at(table, snap, tx_id)
         binder = Binder(self.catalog, params=params or [])
@@ -361,12 +568,12 @@ class Session:
                     old_key = tuple(old_values[k] for k in tablet.key_cols)
                     if old_key != new_key:
                         # PK update = delete old row + insert new row
-                        self.db.tx.write(tx, stmt.table, tablet, old_key,
+                        self._txsvc.write(tx, stmt.table, tablet, old_key,
                                          "delete", old_values)
-                        self.db.tx.write(tx, stmt.table, tablet, new_key,
+                        self._txsvc.write(tx, stmt.table, tablet, new_key,
                                          "insert", values)
                         continue
-                self.db.tx.write(tx, stmt.table, tablet, new_key, "update",
+                self._txsvc.write(tx, stmt.table, tablet, new_key, "update",
                                  values)
 
         self._run_in_tx(op)
@@ -390,7 +597,7 @@ class Session:
                                      else (x.item() if hasattr(x, "item")
                                            else x))
                 key = tuple(values[k] for k in tablet.key_cols)
-                self.db.tx.write(tx, stmt.table, tablet, key, "delete",
+                self._txsvc.write(tx, stmt.table, tablet, key, "delete",
                                  values)
 
         self._run_in_tx(op)
@@ -558,15 +765,15 @@ class Session:
             return _ok()
         if op == "begin":
             if self._tx is not None:
-                self.db.tx.commit(self._tx)  # implicit commit (MySQL)
-            self._tx = self.db.tx.begin()
+                self._txsvc.commit(self._tx)  # implicit commit (MySQL)
+            self._tx = self._txsvc.begin()
         elif op == "commit":
             if self._tx is not None:
-                self.db.tx.commit(self._tx)
+                self._txsvc.commit(self._tx)
                 self._tx = None
         elif op == "rollback":
             if self._tx is not None:
-                self.db.tx.rollback(self._tx)
+                self._txsvc.rollback(self._tx)
                 self._tx = None
         return _ok()
 

@@ -210,6 +210,9 @@ class StorageEngine:
     # compaction driving (≙ tenant tablet scheduler ticks)
     # ------------------------------------------------------------------
     def freeze_and_flush(self, name: str, snapshot: int):
+        from oceanbase_tpu.server.errsim import ERRSIM
+
+        ERRSIM.hit("storage.flush")
         with self._lock:
             ts = self.tables[name]
             ts.tablet.freeze()
@@ -318,6 +321,9 @@ class StorageCatalog(Catalog):
         from oceanbase_tpu.vector import from_numpy
 
         with self._lock:
+            t = self._transients.get(name)
+            if t is not None:
+                return t[1]
             ts = self.engine.tables.get(name)
             if ts is None:
                 raise KeyError(f"table {name} has no data")
@@ -345,6 +351,12 @@ class StorageCatalog(Catalog):
         — the read path active transactions use."""
         from oceanbase_tpu.vector import from_numpy
 
+        with self._lock:
+            # last-writer-wins is fine for transients (virtual tables are
+            # monotonic snapshots), but the lookup itself must be locked
+            t = self._transients.get(name)
+        if t is not None:
+            return t[1]
         ts = self.engine.tables[name]
         arrays, valids = ts.tablet.snapshot_arrays(snapshot, tx_id)
         n = len(next(iter(arrays.values()))) if arrays else 0

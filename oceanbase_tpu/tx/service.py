@@ -115,6 +115,9 @@ class TransService:
     # ------------------------------------------------------------------
     def commit(self, tx: Transaction) -> int:
         """One-phase fast path or full 2PC; returns the commit version."""
+        from oceanbase_tpu.server.errsim import ERRSIM
+
+        ERRSIM.hit("tx.commit")
         with self._lock:
             if tx.state != TxState.ACTIVE:
                 raise TxAborted(f"tx {tx.tx_id} is {tx.state.value}")

@@ -1,0 +1,28 @@
+"""Per-operator plan monitor stats (≙ sql_plan_monitor rows)."""
+
+from oceanbase_tpu.server import Database
+
+
+def test_plan_monitor_rows(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 1), (2, 2), (3, 3)")
+    s.execute("select sum(v) from t where k >= 2")
+    recent = db.plan_monitor.recent(5)
+    assert recent, "plan monitor should have entries"
+    _, _, op_stats, total_s = recent[-1]
+    ops = dict(op_stats)
+    assert ops.get("TableScan") == 3
+    assert ops.get("Filter") == 2
+    assert ops.get("ScalarAgg") == 1
+    # surfaced through SQL too
+    r = s.execute("select operator, output_rows from gv$plan_monitor "
+                  "where operator = 'Filter'")
+    assert (("Filter", 2) in r.rows())
+    # can be turned off at runtime
+    s.execute("alter system set enable_sql_plan_monitor = false")
+    n_before = len(db.plan_monitor.recent(1000))
+    s.execute("select count(*) from t")
+    assert len(db.plan_monitor.recent(1000)) == n_before
+    db.close()

@@ -1,0 +1,79 @@
+"""Regression tests for the runtime code-review findings."""
+
+import os
+
+import pytest
+
+from oceanbase_tpu.server import Database
+
+
+def test_empty_virtual_table_query(tmp_path):
+    # finding 1: 0-row virtual tables must not produce capacity-0 relations
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    r = s.execute("select event from v$wait_events order by event")
+    assert r.rowcount == 0
+    r = s.execute("select count(*) from v$wait_events")
+    assert r.rows() == [(0,)]
+    db.close()
+
+
+def test_dropped_tenant_stays_dropped(tmp_path):
+    # finding 2: drop tenant must remove its data; no resurrection on boot
+    root = str(tmp_path / "db")
+    db = Database(root)
+    db.session().execute("create tenant t1")
+    db.session(tenant="t1").execute("create table x (a int)")
+    db.session().execute("drop tenant t1")
+    db.close()
+    db2 = Database(root)
+    assert "t1" not in db2.tenants
+    db2.session().execute("create tenant t1")  # recreate works
+    db2.close()
+
+
+def test_ash_session_id_joins_audit(tmp_path):
+    # finding 3: ASH rows and audit rows share the same session_id space
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int)")
+    s._ash_state.update(active=True, sql="x", state="executing")
+    db.ash.sample_once()
+    s._ash_state.update(active=False)
+    hist = db.ash.history(10)
+    assert hist and hist[-1][1] == s.session_id
+    recs = db.audit.recent(10)
+    assert recs and recs[-1].session_id == s.session_id
+    # close unregisters
+    s.close()
+    assert s.session_id not in db.ash._sessions
+    db.close()
+
+
+def test_virtual_table_in_insert_select_and_where(tmp_path):
+    # finding 4: INSERT..SELECT and expression subqueries refresh virtuals
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table snap (name varchar(64))")
+    s.execute("select 1 from v$parameters limit 1")  # warm
+    s.execute("insert into snap select name from v$parameters")
+    n = s.execute("select count(*) from snap").rows()[0][0]
+    assert n > 20
+    # expression subquery over a never-before-seen virtual table
+    r = s.execute("select 1 from snap where snap.name in "
+                  "(select tracepoint from v$errsim) limit 1")
+    assert r.rowcount == 0  # no overlap, but it must bind and run
+    db.close()
+
+
+def test_boot_ignores_stray_files(tmp_path):
+    # finding 6: a stray file under tenants/ must not break boot
+    root = str(tmp_path / "db")
+    db = Database(root)
+    db.close()
+    os.makedirs(os.path.join(root, "tenants"), exist_ok=True)
+    with open(os.path.join(root, "tenants", "README"), "w") as f:
+        f.write("not a tenant")
+    db2 = Database(root)
+    assert "README" not in db2.tenants
+    db2.close()

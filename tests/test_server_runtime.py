@@ -1,0 +1,141 @@
+"""Runtime & aux subsystem tests: config, tenants, observability, errsim.
+
+≙ unittest/share config tests + omt tenant tests + virtual-table queries.
+"""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.server.config import Config
+from oceanbase_tpu.server.errsim import ERRSIM
+
+
+def test_config_registry(tmp_path):
+    p = str(tmp_path / "cfg.json")
+    c = Config(persist_path=p)
+    assert c["minor_compact_trigger"] == 4
+    c.set("minor_compact_trigger", "8")   # string coercion
+    assert c["minor_compact_trigger"] == 8
+    with pytest.raises(ValueError):
+        c.set("minor_compact_trigger", 0)  # validator
+    with pytest.raises(KeyError):
+        c.set("no_such_param", 1)
+    c.set("tenant_memory_limit", "2g")     # capacity units
+    assert c["tenant_memory_limit"] == 2 << 30
+    # persisted + reloaded
+    c2 = Config(persist_path=p)
+    assert c2["minor_compact_trigger"] == 8
+    # overlay falls back to parent
+    t = Config(parent=c2)
+    assert t["minor_compact_trigger"] == 8
+    t.set("minor_compact_trigger", 16)
+    assert t["minor_compact_trigger"] == 16 and c2["minor_compact_trigger"] == 8
+
+
+def test_multi_tenant_isolation(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s_sys = db.session()
+    s_sys.execute("create tenant t1")
+    s1 = db.session(tenant="t1")
+    s1.execute("create table x (a int)")
+    s1.execute("insert into x values (1)")
+    # sys tenant does not see t1's table
+    with pytest.raises(Exception):
+        s_sys.execute("select * from x")
+    assert s1.execute("select count(*) from x").rows() == [(1,)]
+    # tenant survives restart
+    db.close()
+    db2 = Database(str(tmp_path / "db"))
+    assert "t1" in db2.tenants
+    assert db2.session(tenant="t1").execute(
+        "select count(*) from x").rows() == [(1,)]
+    db2.close()
+
+
+def test_set_and_alter_system(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("set @@x = 1") if False else None
+    s.execute("set autocommit = 0")
+    assert s.variables["autocommit"] == 0
+    s.execute("alter system set minor_compact_trigger = 6")
+    assert db.config["minor_compact_trigger"] == 6
+    r = s.execute("show parameters")
+    assert r.rowcount > 20
+    r = s.execute("show variables")
+    assert r.rowcount >= 2
+    # major freeze compacts all tables
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 1)")
+    s.execute("alter system major freeze")
+    assert db.engine.tables["t"].tablet.segments[-1].level == 2
+    assert s.execute("select v from t").rows() == [(1,)]
+    db.close()
+
+
+def test_virtual_tables_via_sql(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key)")
+    s.execute("insert into t values (1), (2)")
+    s.execute("select count(*) from t")
+    # audit has the statements above
+    r = s.execute("select sql, rows_returned from gv$sql_audit")
+    assert r.rowcount >= 3
+    # tables inventory
+    r = s.execute("select table_name, row_count from v$tables "
+                  "where tenant = 'sys' order by table_name")
+    assert ("t", 2) in r.rows()
+    # palf replica states
+    r = s.execute("select role, count(*) as n from v$palf group by role "
+                  "order by role")
+    rows = dict(r.rows())
+    assert rows.get("leader") == 1 and rows.get("follower") == 2
+    # parameters
+    r = s.execute("select value from v$parameters "
+                  "where name = 'wal_replica_count'")
+    assert r.rowcount == 1
+    db.close()
+
+
+def test_analyze_updates_stats(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, g int)")
+    s.execute("insert into t values (1, 1), (2, 1), (3, 2)")
+    s.execute("analyze table t")
+    td = db.catalog.table_def("t")
+    assert td.row_count == 3
+    assert td.ndv["g"] == 2 and td.ndv["k"] == 3
+    db.close()
+
+
+def test_errsim_injection(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key)")
+    ERRSIM.arm("tx.commit", error=RuntimeError("injected"), count=1)
+    try:
+        with pytest.raises(RuntimeError, match="injected"):
+            s.execute("insert into t values (1)")
+        # budget consumed: next statement passes, the failed one rolled back
+        s.execute("insert into t values (1)")
+        assert s.execute("select count(*) from t").rows() == [(1,)]
+        r = s.execute("select tracepoint, fired from v$errsim")
+        assert ("tx.commit", 1) in r.rows()
+    finally:
+        ERRSIM.reset()
+    db.close()
+
+
+def test_ash_sampling(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int)")
+    s._ash_state.update(active=True, sql="select 1", state="executing")
+    db.ash.sample_once()
+    s._ash_state.update(active=False)
+    r = s.execute("select sql, state from v$session_history")
+    assert r.rowcount >= 1
+    db.close()
