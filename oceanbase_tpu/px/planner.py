@@ -1,0 +1,260 @@
+"""PX planner: lower a physical plan to a distributed shard_map program.
+
+Reference analog: the DFO manager splitting plans at exchange boundaries
+(ObDfoMgr, src/sql/engine/px/ob_dfo_mgr.h:19) plus the scheduler running
+producer/consumer DFO pairs (ob_dfo_scheduler.cpp).  On TPU the whole DFO
+graph compiles into ONE shard_map program: exchanges are collectives, so
+"scheduling" disappears — XLA pipelines the stages.
+
+Lowering rules (per node, inside the per-shard trace):
+- TableScan            -> the shard's slice of the row-sharded table
+- Filter/Project/
+  Compact/Union        -> shard-local (no data movement)
+- GroupBy              -> partial agg -> all_to_all(hash keys) -> final agg
+- ScalarAgg            -> shard-local partials; the final merge runs on the
+                          gathered result (tiny), via the partial/final
+                          agg split
+- HashJoin /
+  SemiJoinResidual     -> BROADCAST the build side when small (all_gather,
+                          ≙ BC2HOST dist method) else HASH-HASH
+                          repartition both sides (all_to_all)
+- Sort/Limit           -> not distributed: run on the gathered result
+                          (≙ the coordinator's final merge sort)
+
+Capacity overflow inside exchanges is psum-reduced and checked on the
+host; the session's retry loop re-plans with bigger budgets.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from oceanbase_tpu.exec import diag, ops
+from oceanbase_tpu.exec import plan as pp
+from oceanbase_tpu.expr import ir
+from oceanbase_tpu.px.dist_ops import (
+    dist_groupby_shard,
+    dist_join_shard,
+    split_aggs,
+)
+from oceanbase_tpu.px.exchange import (
+    broadcast_gather,
+    default_mesh,
+    shard_relation,
+    unshard_relation,
+)
+from oceanbase_tpu.vector.column import Relation
+
+BROADCAST_THRESHOLD = 1 << 16  # rows; below this, build sides broadcast
+
+_DIST_OK = (pp.TableScan, pp.Filter, pp.Project, pp.GroupBy,
+            pp.HashJoin, pp.SemiJoinResidual, pp.Union, pp.Compact)
+
+
+class NotDistributable(Exception):
+    pass
+
+
+def split_top(plan: pp.PlanNode):
+    """Peel coordinator-side ops off the root
+    -> (top_chain, scalar_agg|None, dist_root).
+
+    top_chain (outermost-first) re-applies on the gathered result.  A
+    root-chain ScalarAgg splits into in-shard partials + a host-side final
+    merge; Projects above it move to the host chain (they reference the
+    final aggregate names)."""
+    top = []
+    node = plan
+    scalar_agg = None
+    while True:
+        if isinstance(node, (pp.Sort, pp.Limit)) and scalar_agg is None:
+            top.append(node)
+            node = node.child
+            continue
+        if isinstance(node, pp.Project) and scalar_agg is None:
+            top.append(node)
+            node = node.child
+            continue
+        if isinstance(node, pp.ScalarAgg) and scalar_agg is None:
+            scalar_agg = node
+            node = node.child
+            continue
+        break
+    _check_distributable(node)
+    return top, scalar_agg, node
+
+
+def _check_distributable(node: pp.PlanNode):
+    if not isinstance(node, _DIST_OK):
+        raise NotDistributable(type(node).__name__)
+    for c in node.children():
+        _check_distributable(c)
+
+
+# ---------------------------------------------------------------------------
+# per-shard lowering
+# ---------------------------------------------------------------------------
+
+
+def _dlower(node: pp.PlanNode, tables: dict, ndev: int, axis: str,
+            factor: int = 1) -> Relation:
+    if isinstance(node, pp.TableScan):
+        rel = tables[node.table]
+        if node.columns is not None:
+            rel = rel.select(node.columns)
+        if node.rename:
+            rel = Relation(
+                columns={node.rename.get(n, n): c
+                         for n, c in rel.columns.items()},
+                mask=rel.mask)
+        return rel
+    if isinstance(node, pp.Filter):
+        return ops.filter_rows(
+            _dlower(node.child, tables, ndev, axis, factor), node.pred)
+    if isinstance(node, pp.Project):
+        return ops.project(
+            _dlower(node.child, tables, ndev, axis, factor), node.outputs)
+    if isinstance(node, pp.Compact):
+        return ops.compact(
+            _dlower(node.child, tables, ndev, axis, factor), node.capacity)
+    if isinstance(node, pp.Union):
+        return ops.concat([
+            _dlower(c, tables, ndev, axis, factor) for c in node.inputs])
+    if isinstance(node, pp.GroupBy):
+        child = _dlower(node.child, tables, ndev, axis, factor)
+        local_cap = (node.out_capacity or 1 << 16) * factor
+        rel, ovf = dist_groupby_shard(
+            child, node.keys, node.aggs, ndev=ndev,
+            local_cap=local_cap, out_cap=local_cap, axis_name=axis)
+        diag.push("px_exchange_overflow", ovf)
+        return rel
+    if isinstance(node, pp.HashJoin):
+        left = _dlower(node.left, tables, ndev, axis, factor)
+        right = _dlower(node.right, tables, ndev, axis, factor)
+        return _djoin(left, right, node.left_keys, node.right_keys,
+                      node.how, node.out_capacity, ndev, axis, factor)
+    if isinstance(node, pp.SemiJoinResidual):
+        left = _dlower(node.left, tables, ndev, axis, factor)
+        right = _dlower(node.right, tables, ndev, axis, factor)
+        # correctness needs the complete candidate set per probe row:
+        # broadcast the inner side (residual evaluated locally)
+        bright = broadcast_gather(right, axis)
+        return ops.semi_join_residual(
+            left, bright, node.left_keys, node.right_keys, node.residual,
+            anti=node.anti, out_capacity=node.out_capacity)
+    raise NotDistributable(type(node).__name__)
+
+
+def _djoin(left, right, lkeys, rkeys, how, cap, ndev, axis, factor=1):
+    if right.capacity <= BROADCAST_THRESHOLD or not lkeys:
+        # small or keyless build side: replicate it (BROADCAST dist)
+        bright = broadcast_gather(right, axis)
+        return ops.join(left, bright, lkeys, rkeys, how=how,
+                        out_capacity=cap)
+    # HASH-HASH repartition (≙ ObSliceIdxCalc HASH both sides); the
+    # per-destination budget scales with the session's retry factor
+    # because exchange caps derive from input capacities, which plan-level
+    # scale_capacities cannot reach
+    per_dest = max((max(left.capacity, right.capacity) + ndev - 1)
+                   // ndev * 2, 1024) * factor
+    local_cap = cap if cap is None else max(cap // ndev * 2, 1024)
+    out, ovf = dist_join_shard(
+        left, right, lkeys, rkeys, ndev=ndev, cap_per_dest=per_dest,
+        out_capacity=local_cap, how=how, axis_name=axis)
+    diag.push("px_exchange_overflow", ovf)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+
+class _Holder:
+    """Hashable wrapper keying the PX compile cache on the plan
+    fingerprint (≙ exec.plan._PlanHolder)."""
+
+    def __init__(self, droot, partial_specs, key):
+        self.droot = droot
+        self.partial_specs = partial_specs
+        self.key = key
+
+    def __hash__(self):
+        return hash(self.key)
+
+    def __eq__(self, other):
+        return isinstance(other, _Holder) and other.key == self.key
+
+
+@functools.lru_cache(maxsize=64)
+def _px_compiled(plan_key, holder, mesh, axis, ndev, factor, table_names):
+    droot = holder.droot
+    partial_specs = holder.partial_specs
+
+    def shard_body(shtables):
+        with diag.collect() as entries:
+            rel = _dlower(droot, shtables, ndev, axis, factor)
+            if partial_specs is not None:
+                rel = ops.scalar_agg(rel, partial_specs)
+            total_ovf = jnp.zeros((), dtype=jnp.int64)
+            for _name, v in entries:
+                total_ovf = total_ovf + jnp.asarray(v, dtype=jnp.int64)
+        return rel, jax.lax.psum(total_ovf, axis)
+
+    return jax.jit(jax.shard_map(
+        shard_body, mesh=mesh,
+        in_specs=({t: P(axis) for t in table_names},),
+        out_specs=(P(axis), P()),
+        check_vma=False,
+    ))
+
+
+def execute_plan_distributed(plan: pp.PlanNode, tables: dict,
+                             mesh=None, dop: int | None = None,
+                             budget_factor: int = 1) -> Relation:
+    """Run a physical plan distributed over the mesh; returns the final
+    (host-side single-device) relation.  Raises NotDistributable when the
+    plan shape isn't supported (caller falls back to single-node).
+    ``budget_factor`` scales exchange buffer budgets on CapacityOverflow
+    retries (plan-level scale_capacities cannot reach them)."""
+    top, scalar_agg, droot = split_top(plan)
+    if mesh is None:
+        mesh = default_mesh(dop)
+    axis = mesh.axis_names[0]
+    ndev = mesh.devices.size
+
+    needed = pp.referenced_tables(droot)
+    sharded = {t: shard_relation(tables[t], mesh, axis)
+               for t in needed}
+
+    partial_specs = final_specs = post = None
+    if scalar_agg is not None:
+        partial_specs, final_specs, post = split_aggs(scalar_agg.aggs)
+
+    run = _px_compiled(
+        plan.fingerprint(), _Holder(droot, partial_specs, plan.fingerprint()),
+        mesh, axis, ndev, budget_factor, tuple(sorted(needed)))
+    out, overflow = run(sharded)
+    if int(overflow) > 0:
+        raise diag.CapacityOverflow(
+            f"PX exchange overflow: {int(overflow)} rows dropped")
+    rel = unshard_relation(out)
+
+    if scalar_agg is not None:
+        # final merge of the gathered per-shard partials
+        rel = ops.scalar_agg(rel, final_specs)
+        rel = ops.project(rel, dict(post))
+
+    # re-apply the coordinator-side top chain, innermost first
+    for node in reversed(top):
+        if isinstance(node, pp.Sort):
+            rel = ops.sort_rows(rel, node.keys, node.ascending)
+        elif isinstance(node, pp.Limit):
+            rel = ops.limit(rel, node.k, node.offset)
+        elif isinstance(node, pp.Project):
+            rel = ops.project(rel, node.outputs)
+    return rel

@@ -345,12 +345,18 @@ class Session:
                 getattr(self.db, "plan_monitor", None) is not None and \
                 self.db.config["enable_sql_plan_monitor"]:
             monitor = []
+        dop = self._px_dop()
         factor = 1
         t0 = time.time()
         for attempt in range(int(self.variables["max_capacity_retry"]) + 1):
             try:
                 p = plan if factor == 1 else scale_capacities(plan, factor)
-                rel = execute_plan(p, tables, monitor_out=monitor)
+                rel = None
+                if dop > 1:
+                    rel = self._try_px(p, tables, dop, factor=factor,
+                                       monitor=monitor)
+                if rel is None:
+                    rel = execute_plan(p, tables, monitor_out=monitor)
                 break
             except CapacityOverflow:
                 if attempt >= int(self.variables["max_capacity_retry"]):
@@ -363,6 +369,45 @@ class Session:
                 plan.fingerprint()[:64] if hasattr(plan, "fingerprint")
                 else "", monitor, time.time() - t0)
         return self._materialize(rel, outputs)
+
+    def _px_dop(self) -> int:
+        """Effective degree of parallelism.  A session px_dop wins over the
+        config default; setting it to 0/1 EXPLICITLY forces serial
+        execution (≙ the /*+ no_parallel */ hint)."""
+        if "px_dop" in self.variables:
+            dop = int(self.variables["px_dop"] or 0)
+        elif self.db is not None:
+            dop = int(self.db.config["px_default_dop"])
+        else:
+            dop = 0
+        if dop <= 1:
+            return 1
+        import jax
+
+        return min(dop, len(jax.devices()))
+
+    def _try_px(self, plan, tables, dop, factor=1, monitor=None):
+        """Attempt distributed execution; None -> fall back to single-node
+        (unsupported plan shape, ≙ the optimizer declining a PX plan)."""
+        from oceanbase_tpu.px.planner import (
+            NotDistributable,
+            execute_plan_distributed,
+        )
+
+        if self.tenant is not None:
+            if not self.tenant.px_admission.acquire(blocking=False):
+                return None  # admission denied: run serial (≙ px downgrade)
+        try:
+            rel = execute_plan_distributed(plan, tables, dop=dop,
+                                           budget_factor=factor)
+        except (NotDistributable, NotImplementedError):
+            return None
+        finally:
+            if self.tenant is not None:
+                self.tenant.px_admission.release()
+        if monitor is not None:
+            monitor.append((f"PxExecute(dop={dop})", int(rel.count())))
+        return rel
 
     def _materialize(self, rel: Relation, outputs) -> Result:
         raw = to_numpy(rel)
