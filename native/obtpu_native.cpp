@@ -1,0 +1,143 @@
+// Native runtime kernels for oceanbase_tpu's host control plane.
+//
+// Reference analog: the reference implements its checksums and block codecs
+// in C++ with SIMD (crc64 with hardware acceleration in
+// deps/oblib/src/lib/checksum, cs_encoding integer codecs in
+// src/storage/blocksstable/cs_encoding).  The TPU build keeps the device
+// compute in XLA/Pallas; these host-side hot loops (log integrity, segment
+// wire codecs) are native for the same reason the reference's are: they sit
+// on the WAL fsync path and the segment persistence path.
+//
+// Exposed via a C ABI consumed through ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CRC-64 (ECMA-182 polynomial, as used by XZ): slicing-by-8 table version.
+// ---------------------------------------------------------------------------
+
+static uint64_t crc64_table[8][256];
+static bool crc64_init_done = false;
+
+static void crc64_init() {
+    const uint64_t poly = 0xC96C5795D7870F42ULL;  // reflected ECMA-182
+    for (int i = 0; i < 256; i++) {
+        uint64_t crc = (uint64_t)i;
+        for (int j = 0; j < 8; j++) {
+            crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+        }
+        crc64_table[0][i] = crc;
+    }
+    for (int t = 1; t < 8; t++) {
+        for (int i = 0; i < 256; i++) {
+            uint64_t crc = crc64_table[t - 1][i];
+            crc64_table[t][i] = (crc >> 8) ^ crc64_table[0][crc & 0xFF];
+        }
+    }
+    crc64_init_done = true;
+}
+
+uint64_t obtpu_crc64(const uint8_t* data, uint64_t len, uint64_t seed) {
+    if (!crc64_init_done) crc64_init();
+    uint64_t crc = ~seed;
+    // 8-byte strides through the slicing tables
+    while (len >= 8) {
+        uint64_t word;
+        memcpy(&word, data, 8);
+        crc ^= word;
+        crc = crc64_table[7][crc & 0xFF] ^
+              crc64_table[6][(crc >> 8) & 0xFF] ^
+              crc64_table[5][(crc >> 16) & 0xFF] ^
+              crc64_table[4][(crc >> 24) & 0xFF] ^
+              crc64_table[3][(crc >> 32) & 0xFF] ^
+              crc64_table[2][(crc >> 40) & 0xFF] ^
+              crc64_table[1][(crc >> 48) & 0xFF] ^
+              crc64_table[0][(crc >> 56) & 0xFF];
+        data += 8;
+        len -= 8;
+    }
+    while (len--) {
+        crc = crc64_table[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+    }
+    return ~crc;
+}
+
+// ---------------------------------------------------------------------------
+// Integer block codec: delta + zigzag + varint (LEB128).
+// encode: int64[n] -> bytes; returns encoded length (or 0 on overflow).
+// decode: bytes -> int64[n]; returns consumed length (0 on error).
+// Worst case 10 bytes per value; callers size out_cap accordingly.
+// ---------------------------------------------------------------------------
+
+static inline uint64_t zigzag(int64_t v) {
+    return ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+}
+
+static inline int64_t unzigzag(uint64_t u) {
+    return (int64_t)(u >> 1) ^ -(int64_t)(u & 1);
+}
+
+uint64_t obtpu_delta_varint_encode(const int64_t* in, uint64_t n,
+                                   uint8_t* out, uint64_t out_cap) {
+    uint64_t pos = 0;
+    int64_t prev = 0;
+    for (uint64_t i = 0; i < n; i++) {
+        // delta in wrapping (unsigned) arithmetic: signed int64 overflow
+        // is UB, and deltas like MAX-MIN exceed the signed range anyway
+        uint64_t delta = (uint64_t)in[i] - (uint64_t)prev;
+        uint64_t u = zigzag((int64_t)delta);
+        prev = in[i];
+        do {
+            if (pos >= out_cap) return 0;
+            uint8_t byte = u & 0x7F;
+            u >>= 7;
+            out[pos++] = byte | (u ? 0x80 : 0);
+        } while (u);
+    }
+    return pos;
+}
+
+uint64_t obtpu_delta_varint_decode(const uint8_t* in, uint64_t in_len,
+                                   int64_t* out, uint64_t n) {
+    uint64_t pos = 0;
+    int64_t prev = 0;
+    for (uint64_t i = 0; i < n; i++) {
+        uint64_t u = 0;
+        int shift = 0;
+        while (true) {
+            if (pos >= in_len || shift > 63) return 0;
+            uint8_t byte = in[pos++];
+            u |= (uint64_t)(byte & 0x7F) << shift;
+            if (!(byte & 0x80)) break;
+            shift += 7;
+        }
+        prev = (int64_t)((uint64_t)prev + (uint64_t)unzigzag(u));
+        out[i] = prev;
+    }
+    return pos;
+}
+
+// ---------------------------------------------------------------------------
+// Run-length scan: fills starts[] with run-start indices; returns run count
+// (used by the RLE encoder to avoid a python-level pass).
+// ---------------------------------------------------------------------------
+
+uint64_t obtpu_rle_runs_i64(const int64_t* in, uint64_t n,
+                            uint64_t* starts, uint64_t cap) {
+    if (n == 0) return 0;
+    uint64_t count = 0;
+    if (count < cap) starts[count] = 0;
+    count++;
+    for (uint64_t i = 1; i < n; i++) {
+        if (in[i] != in[i - 1]) {
+            if (count < cap) starts[count] = i;
+            count++;
+        }
+    }
+    return count;
+}
+
+}  // extern "C"

@@ -1,0 +1,191 @@
+"""ctypes bridge to the native host-runtime kernels (native/).
+
+Builds lazily with make on first import if the shared library is missing;
+every entry point has a pure-numpy fallback so the framework works without
+a toolchain (≙ the reference's portable fallbacks next to SIMD paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                           "native")
+_SO = os.path.join(_NATIVE_DIR, "libobtpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_attempted = False
+
+
+_MASK64 = (1 << 64) - 1
+
+
+def _load():
+    global _lib, _build_attempted
+    if _lib is not None:  # lock-free fast path (hot on the WAL append path)
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) and not _build_attempted:
+            _build_attempted = True
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                               capture_output=True, timeout=120)
+            except Exception:
+                return None
+        if not os.path.exists(_SO):
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.obtpu_crc64.restype = ctypes.c_uint64
+        lib.obtpu_crc64.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                    ctypes.c_uint64]
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+        lib.obtpu_delta_varint_encode.restype = ctypes.c_uint64
+        lib.obtpu_delta_varint_encode.argtypes = [
+            i64p, ctypes.c_uint64, u8p, ctypes.c_uint64]
+        lib.obtpu_delta_varint_decode.restype = ctypes.c_uint64
+        lib.obtpu_delta_varint_decode.argtypes = [
+            u8p, ctypes.c_uint64, i64p, ctypes.c_uint64]
+        lib.obtpu_rle_runs_i64.restype = ctypes.c_uint64
+        lib.obtpu_rle_runs_i64.argtypes = [
+            i64p, ctypes.c_uint64, u64p, ctypes.c_uint64]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# crc64 (log/segment integrity)
+# ---------------------------------------------------------------------------
+
+_PY_TABLE = None
+
+
+def _py_crc64_table():
+    global _PY_TABLE
+    if _PY_TABLE is None:
+        poly = np.uint64(0xC96C5795D7870F42)
+        table = np.zeros(256, dtype=np.uint64)
+        for i in range(256):
+            crc = np.uint64(i)
+            for _ in range(8):
+                crc = (crc >> np.uint64(1)) ^ (
+                    poly if crc & np.uint64(1) else np.uint64(0))
+            table[i] = crc
+        _PY_TABLE = table
+    return _PY_TABLE
+
+
+def crc64(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    if lib is not None:
+        return int(lib.obtpu_crc64(data, len(data), seed))
+    # numpy fallback (byte-at-a-time through the table)
+    table = _py_crc64_table()
+    crc = np.uint64(~seed & 0xFFFFFFFFFFFFFFFF)
+    for b in data:
+        crc = table[int((crc ^ np.uint64(b)) & np.uint64(0xFF))] ^ \
+            (crc >> np.uint64(8))
+    return int(~crc & 0xFFFFFFFFFFFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# delta + zigzag + varint codec (segment persistence)
+# ---------------------------------------------------------------------------
+
+
+def delta_varint_encode(values: np.ndarray) -> bytes:
+    values = np.ascontiguousarray(values, dtype=np.int64)
+    lib = _load()
+    if lib is not None:
+        out = np.empty(len(values) * 10 + 16, dtype=np.uint8)
+        n = int(lib.obtpu_delta_varint_encode(values, len(values), out,
+                                              len(out)))
+        if n:
+            return out[:n].tobytes()
+    # python fallback: deltas in wrapping 64-bit arithmetic (matches the
+    # native codec for full-range values like MAX-MIN)
+    out_b = bytearray()
+    prev = 0
+    for v in values.tolist():
+        d = (v - prev) & _MASK64
+        if d >= 1 << 63:
+            d -= 1 << 64  # back to signed
+        u = ((d << 1) ^ (d >> 63)) & _MASK64
+        prev = v
+        while True:
+            b = u & 0x7F
+            u >>= 7
+            out_b.append(b | (0x80 if u else 0))
+            if not u:
+                break
+    return bytes(out_b)
+
+
+def delta_varint_decode(buf: bytes, n: int) -> np.ndarray:
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    lib = _load()
+    if lib is not None:
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        out = np.empty(n, dtype=np.int64)
+        used = int(lib.obtpu_delta_varint_decode(
+            np.ascontiguousarray(arr), len(arr), out, n))
+        if used == 0:
+            raise ValueError("corrupt varint payload (native decode failed)")
+        return out
+    out_l = np.empty(n, dtype=np.int64)
+    pos = 0
+    prev = 0
+    try:
+        for i in range(n):
+            u = 0
+            shift = 0
+            while True:
+                b = buf[pos]
+                pos += 1
+                u |= (b & 0x7F) << shift
+                if not (b & 0x80):
+                    break
+                shift += 7
+                if shift > 63:
+                    raise ValueError("corrupt varint payload")
+            d = (u >> 1) ^ -(u & 1)
+            prev = (prev + d) & _MASK64
+            if prev >= 1 << 63:
+                prev -= 1 << 64
+            out_l[i] = prev
+    except IndexError:
+        raise ValueError("corrupt varint payload (truncated)") from None
+    return out_l
+
+
+def rle_run_starts(values: np.ndarray) -> np.ndarray:
+    values = np.ascontiguousarray(values, dtype=np.int64)
+    lib = _load()
+    if lib is not None:
+        starts = np.empty(len(values), dtype=np.uint64)
+        n = int(lib.obtpu_rle_runs_i64(values, len(values), starts,
+                                       len(starts)))
+        return starts[:n].astype(np.int64)
+    if len(values) == 0:
+        return np.zeros(0, dtype=np.int64)
+    change = np.empty(len(values), dtype=bool)
+    change[0] = True
+    np.not_equal(values[1:], values[:-1], out=change[1:])
+    return np.nonzero(change)[0]

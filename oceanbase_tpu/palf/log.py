@@ -22,7 +22,10 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-_HDR = struct.Struct("<QQI")  # term, lsn(index), payload_len
+from oceanbase_tpu.native import crc64
+
+_HDR = struct.Struct("<QQIQ")  # term, lsn(index), payload_len, crc64
+_MAGIC = b"OBTPULG1"  # file magic + format version (bump on layout change)
 
 
 @dataclass
@@ -32,7 +35,12 @@ class LogEntry:
     payload: bytes
 
     def encode(self) -> bytes:
-        return _HDR.pack(self.term, self.lsn, len(self.payload)) + self.payload
+        """Wire/disk format with a crc64 integrity checksum over
+        (term, lsn, payload) — ≙ the reference's log-entry checksums
+        (accumulated data checksums in the log group entries)."""
+        crc = crc64(struct.pack("<QQ", self.term, self.lsn) + self.payload)
+        return _HDR.pack(self.term, self.lsn, len(self.payload), crc) + \
+            self.payload
 
 
 class PalfReplica:
@@ -65,7 +73,11 @@ class PalfReplica:
         if self.log_dir is None:
             return
         if self._log_f is None:
-            self._log_f = open(self._log_path(), "ab")
+            path = self._log_path()
+            fresh = not os.path.exists(path) or os.path.getsize(path) == 0
+            self._log_f = open(path, "ab")
+            if fresh:
+                self._log_f.write(_MAGIC)
         for e in entries:
             self._log_f.write(e.encode())
         self._log_f.flush()
@@ -80,6 +92,7 @@ class PalfReplica:
             self._log_f = None
         tmp = self._log_path() + ".tmp"
         with open(tmp, "wb") as f:
+            f.write(_MAGIC)
             for e in self.entries:
                 f.write(e.encode())
             f.flush()
@@ -92,13 +105,21 @@ class PalfReplica:
             return
         with open(path, "rb") as f:
             buf = f.read()
-        off = 0
+        if not buf.startswith(_MAGIC):
+            # unknown/older format: refuse to guess — treat as unreadable
+            # (peer catch-up restores state; a format migration tool would
+            # go here)
+            return
+        off = len(_MAGIC)
         while off + _HDR.size <= len(buf):
-            term, lsn, plen = _HDR.unpack_from(buf, off)
+            term, lsn, plen, crc = _HDR.unpack_from(buf, off)
             off += _HDR.size
             if off + plen > len(buf):
                 break  # torn tail write: discard (≙ log tail scan)
-            self.entries.append(LogEntry(term, lsn, buf[off:off + plen]))
+            payload = buf[off:off + plen]
+            if crc64(struct.pack("<QQ", term, lsn) + payload) != crc:
+                break  # corrupt tail: stop replay here (≙ checksum scan)
+            self.entries.append(LogEntry(term, lsn, payload))
             off += plen
         if self.entries:
             self.current_term = self.entries[-1].term

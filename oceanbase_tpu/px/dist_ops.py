@@ -94,8 +94,9 @@ def dist_groupby_shard(
 
     outs = {k: ir.col(k) for k in keys}
     outs.update(post)
-    overflow = jax.lax.psum(l_ovf + x_ovf + f_ovf, axis_name)
-    return project(final, outs), overflow
+    # LOCAL overflow count: callers needing a replicated/global value psum
+    # it themselves (avoids double-psum when composed, see px/planner.py)
+    return project(final, outs), l_ovf + x_ovf + f_ovf
 
 
 def dist_groupby(
@@ -112,10 +113,12 @@ def dist_groupby(
     ndev = mesh.devices.size
     sharded = shard_relation(rel, mesh, axis)
 
-    fn = partial(
-        dist_groupby_shard, keys=keys, aggs=aggs, ndev=ndev,
-        local_cap=local_cap, out_cap=out_cap, axis_name=axis,
-    )
+    def fn(rel):
+        out, local_ovf = dist_groupby_shard(
+            rel, keys=keys, aggs=aggs, ndev=ndev,
+            local_cap=local_cap, out_cap=out_cap, axis_name=axis)
+        return out, jax.lax.psum(local_ovf, axis)
+
     spec = P(axis)
     run = jax.jit(
         jax.shard_map(
@@ -158,4 +161,4 @@ def dist_join_shard(
                                         axis_name)
     out = join(lrecv, rrecv, left_keys, right_keys, how=how,
                out_capacity=out_capacity)
-    return out, jax.lax.psum(lov + rov, axis_name)
+    return out, lov + rov  # LOCAL count; callers psum as needed

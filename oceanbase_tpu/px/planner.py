@@ -126,7 +126,10 @@ def _dlower(node: pp.PlanNode, tables: dict, ndev: int, axis: str,
             _dlower(c, tables, ndev, axis, factor) for c in node.inputs])
     if isinstance(node, pp.GroupBy):
         child = _dlower(node.child, tables, ndev, axis, factor)
-        local_cap = (node.out_capacity or 1 << 16) * factor
+        # node.out_capacity was already scaled by scale_capacities on
+        # retries; apply the factor only to the built-in default
+        local_cap = (node.out_capacity if node.out_capacity is not None
+                     else (1 << 16) * factor)
         rel, ovf = dist_groupby_shard(
             child, node.keys, node.aggs, ndev=ndev,
             local_cap=local_cap, out_cap=local_cap, axis_name=axis)

@@ -135,6 +135,14 @@ def encode_column(arr: np.ndarray, valid: np.ndarray | None) -> EncodedColumn:
                 (sz, "delta", {"base": np.int64(arr[0]), "deltas": deltas})
             )
 
+    # VARINT (delta+zigzag+LEB128 via the native codec): byte-granular,
+    # often the smallest for int64 key/date columns
+    if arr.dtype.kind in "iu" and arr.itemsize == 8 and n > 0:
+        from oceanbase_tpu.native import delta_varint_encode
+
+        buf = np.frombuffer(delta_varint_encode(arr), dtype=np.uint8)
+        candidates.append((buf.nbytes, "varint", {"buf": buf}))
+
     sz, enc, payload = min(candidates, key=lambda c: c[0])
     return EncodedColumn(enc, payload, valid, zone, n)
 
@@ -150,6 +158,10 @@ def decode_column(ec: EncodedColumn, out_dtype=None) -> np.ndarray:
         base = ec.payload["base"]
         deltas = ec.payload["deltas"].astype(np.int64)
         data = np.concatenate([[0], np.cumsum(deltas)]) + base
+    elif ec.encoding == "varint":
+        from oceanbase_tpu.native import delta_varint_decode
+
+        data = delta_varint_decode(ec.payload["buf"].tobytes(), ec.n)
     else:  # pragma: no cover
         raise ValueError(ec.encoding)
     if out_dtype is not None and data.dtype != out_dtype:

@@ -55,11 +55,13 @@ def test_dist_join_matches_local(rng, mesh):
 
     ls = shard_relation(left, mesh)
     rs = shard_relation(right, mesh)
-    fn = partial(
-        dist_join_shard,
-        left_keys=[ir.col("fk")], right_keys=[ir.col("pk")],
-        ndev=8, cap_per_dest=nl // 4, out_capacity=nl, how="inner",
-    )
+
+    def fn(l, r):
+        out, local_ovf = dist_join_shard(
+            l, r, left_keys=[ir.col("fk")], right_keys=[ir.col("pk")],
+            ndev=8, cap_per_dest=nl // 4, out_capacity=nl, how="inner")
+        return out, jax.lax.psum(local_ovf, "px")
+
     run = jax.jit(jax.shard_map(
         fn, mesh=mesh, in_specs=(P("px"), P("px")), out_specs=(P("px"), P()),
         check_vma=False,
