@@ -112,6 +112,18 @@ class SemiJoinResidual(PlanNode):
 
 
 @dataclass(repr=True)
+class Window(PlanNode):
+    """Window functions: adds result columns (≙ the window-function op,
+    src/sql/engine/window_function)."""
+
+    child: PlanNode
+    specs: list  # list[(out_colid, ir.WindowCall)]
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(repr=True)
 class Union(PlanNode):
     """UNION ALL (concat); distinct layered via GroupBy above."""
 
@@ -200,6 +212,10 @@ def _lower_inner(node: PlanNode, tables: dict[str, Relation]) -> Relation:
         )
     if isinstance(node, Union):
         return ops.concat([_lower(c, tables) for c in node.inputs])
+    if isinstance(node, Window):
+        from oceanbase_tpu.exec.window import window as window_op
+
+        return window_op(_lower(node.child, tables), node.specs)
     if isinstance(node, Sort):
         return ops.sort_rows(_lower(node.child, tables), node.keys, node.ascending)
     if isinstance(node, Limit):

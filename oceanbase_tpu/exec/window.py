@@ -1,0 +1,205 @@
+"""Vectorized window functions.
+
+Reference analog: the vectorized window-function operator
+(src/sql/engine/window_function, 16k LoC).  TPU-first design: one lexsort
+by (partition keys, order keys), then every supported function is a
+segment-scan primitive over the sorted order —
+
+- row_number          position within partition
+- rank / dense_rank   order-key-change boundaries (cummax / cumsum)
+- agg OVER(part)      segment reduce broadcast back to rows
+- agg OVER(part ORDER BY ...)  running prefix (cumsum/cummax/cummin) with
+  RANGE-frame peer smearing: tied order keys share the frame value at the
+  last peer (MySQL's default frame semantics)
+
+Results scatter back to the original row order, so the operator composes
+anywhere in the plan without disturbing downstream ops.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oceanbase_tpu.datatypes import SqlType, TypeKind
+from oceanbase_tpu.expr import ir
+from oceanbase_tpu.expr.compile import cast_column, eval_expr
+from oceanbase_tpu.vector.column import Column, Relation
+
+_INT_MAX = np.iinfo(np.int64).max
+
+
+def window(rel: Relation, specs: Sequence[tuple]) -> Relation:
+    """specs: [(out_name, ir.WindowCall)]; returns rel + result columns."""
+    out_cols = dict(rel.columns)
+    for name, wc in specs:
+        out_cols[name] = _one_window(rel, wc)
+    return Relation(columns=out_cols, mask=rel.mask)
+
+
+def _one_window(rel: Relation, wc: ir.WindowCall) -> Column:
+    n = rel.capacity
+    m = rel.mask_or_true()
+    part_cols = [eval_expr(e, rel) for e in (wc.partition_by or [])]
+    order_cols = [(eval_expr(e, rel), asc) for e, asc in (wc.order_by or [])]
+
+    # lexsort: dead last, then partition keys, then order keys
+    minor_to_major = []
+    for c, asc in reversed(order_cols):
+        d = c.data.astype(jnp.int64) if c.data.dtype == jnp.bool_ else c.data
+        if not asc:
+            d = -d if not jnp.issubdtype(d.dtype, jnp.floating) else -d
+        minor_to_major.append(d)
+        if c.valid is not None:
+            nk = jnp.where(c.valid, 0, -1 if asc else 1).astype(jnp.int8)
+            minor_to_major.append(nk)
+    for c in reversed(part_cols):
+        d = jnp.where(c.valid, c.data, jnp.zeros((), c.data.dtype)) \
+            if c.valid is not None else c.data
+        minor_to_major.append(d)
+        if c.valid is not None:
+            minor_to_major.append((~c.valid).astype(jnp.int8))
+    minor_to_major.append((~m).astype(jnp.int8))
+    order = jnp.lexsort(tuple(minor_to_major))
+    inv = jnp.argsort(order)  # scatter-back permutation
+    s_live = jnp.take(m, order)
+
+    # partition boundaries in sorted order
+    new_part = jnp.concatenate([jnp.ones(1, jnp.bool_),
+                                jnp.zeros(n - 1, jnp.bool_)]) if n else \
+        jnp.zeros(0, jnp.bool_)
+    for c in part_cols:
+        d = jnp.where(c.valid, c.data, jnp.zeros((), c.data.dtype)) \
+            if c.valid is not None else c.data
+        sd = jnp.take(d, order)
+        new_part = new_part | jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), sd[1:] != sd[:-1]])
+        if c.valid is not None:
+            sv = jnp.take(c.valid, order)
+            new_part = new_part | jnp.concatenate(
+                [jnp.ones(1, jnp.bool_), sv[1:] != sv[:-1]])
+    part_id = jnp.cumsum(new_part.astype(jnp.int64)) - 1
+    pos = jnp.arange(n)
+    part_start = jax.ops.segment_min(pos, part_id, num_segments=n)
+    start_of_row = jnp.take(part_start, part_id)
+    pos_in_part = pos - start_of_row
+
+    # order-key change boundaries ("peers" share rank / frame values)
+    new_peer = new_part
+    for c, _asc in order_cols:
+        sd = jnp.take(c.data, order)
+        new_peer = new_peer | jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), sd[1:] != sd[:-1]])
+        if c.valid is not None:
+            sv = jnp.take(c.valid, order)
+            new_peer = new_peer | jnp.concatenate(
+                [jnp.ones(1, jnp.bool_), sv[1:] != sv[:-1]])
+
+    fn = wc.fn
+    if fn == "row_number":
+        res = pos_in_part + 1
+        return Column(jnp.take(res, inv), rel.mask, SqlType.int_())
+    if fn == "rank":
+        # start position of the current peer group, relative to partition
+        peer_start = jnp.where(new_peer, pos, 0)
+        peer_start = jax.lax.associative_scan(jnp.maximum, peer_start)
+        res = peer_start - start_of_row + 1
+        return Column(jnp.take(res, inv), rel.mask, SqlType.int_())
+    if fn == "dense_rank":
+        in_part_newpeer = (new_peer & ~new_part).astype(jnp.int64)
+        cums = jnp.cumsum(in_part_newpeer)
+        base = jnp.take(cums, jnp.clip(start_of_row, 0, n - 1))
+        res = cums - base + 1
+        return Column(jnp.take(res, inv), rel.mask, SqlType.int_())
+
+    # window aggregates
+    if fn == "count_star":
+        ac = Column(jnp.ones(n, dtype=jnp.int64), None, SqlType.int_())
+    else:
+        assert wc.arg is not None, f"{fn} needs an argument"
+        ac = eval_expr(wc.arg, rel)
+        if ac.dtype.kind == TypeKind.BOOL:
+            ac = cast_column(ac, SqlType.int_())
+    s_data = jnp.take(ac.data, order)
+    s_valid = jnp.take(ac.valid, order) if ac.valid is not None else None
+    weight = s_live if s_valid is None else (s_live & s_valid)
+
+    ordered = bool(wc.order_by)
+    rt = SqlType.int_() if fn in ("count", "count_star") else \
+        (SqlType.double() if fn == "avg" else ac.dtype)
+
+    def running(x, op, identity):
+        """prefix-scan within partitions (reset at partition starts)."""
+        if op == "sum":
+            cums = jnp.cumsum(x)
+            base = jnp.take(cums, jnp.clip(start_of_row - 1, 0, n - 1))
+            base = jnp.where(start_of_row == 0, 0, base)
+            return cums - base
+        # running min/max via associative scan with partition reset:
+        # inject identity at partition starts through a segmented scan
+        def seg_op(a, b):
+            av, af = a
+            bv, bf = b
+            v = jnp.where(bf, bv, op(av, bv))
+            return v, af | bf
+        flags = new_part
+        vals, _ = jax.lax.associative_scan(seg_op, (x, flags))
+        return vals
+
+    if fn in ("sum", "avg", "count", "count_star"):
+        x = jnp.where(weight, s_data if fn in ("sum", "avg")
+                      else jnp.ones(n, dtype=jnp.int64),
+                      jnp.zeros((), s_data.dtype if fn in ("sum", "avg")
+                                else jnp.int64))
+        if ordered:
+            run = running(x, "sum", 0)
+            cnt = running(weight.astype(jnp.int64), "sum", 0)
+        else:
+            tot = jax.ops.segment_sum(x, part_id, num_segments=n)
+            run = jnp.take(tot, part_id)
+            cntt = jax.ops.segment_sum(weight.astype(jnp.int64), part_id,
+                                       num_segments=n)
+            cnt = jnp.take(cntt, part_id)
+    elif fn in ("min", "max"):
+        from oceanbase_tpu.exec.ops import _agg_identity
+
+        ident = _agg_identity(fn, s_data.dtype)
+        x = jnp.where(weight, s_data, ident)
+        opf = jnp.minimum if fn == "min" else jnp.maximum
+        if ordered:
+            run = running(x, opf, ident)
+            cnt = running(weight.astype(jnp.int64), "sum", 0)
+        else:
+            segf = jax.ops.segment_min if fn == "min" else jax.ops.segment_max
+            tot = segf(x, part_id, num_segments=n)
+            run = jnp.take(tot, part_id)
+            cntt = jax.ops.segment_sum(weight.astype(jnp.int64), part_id,
+                                       num_segments=n)
+            cnt = jnp.take(cntt, part_id)
+    else:
+        raise NotImplementedError(f"window function {fn}")
+
+    if ordered:
+        # RANGE frame: peers share the value at the LAST row of the peer
+        # group — gather the running value from each group's last position
+        peer_id = jnp.cumsum(new_peer.astype(jnp.int64)) - 1
+        last_pos = jax.ops.segment_max(pos, peer_id, num_segments=n)
+        lp = jnp.clip(jnp.take(last_pos, peer_id), 0, max(n - 1, 0))
+        run = jnp.take(run, lp)
+        cnt = jnp.take(cnt, lp)
+
+    if fn == "avg":
+        if ac.dtype.kind == TypeKind.DECIMAL:
+            num = run.astype(jnp.float64) / (10 ** ac.dtype.scale)
+        else:
+            num = run.astype(jnp.float64)
+        res = num / jnp.maximum(cnt, 1).astype(jnp.float64)
+        valid = jnp.take(cnt > 0, inv)
+        return Column(jnp.take(res, inv), valid, rt)
+    if fn in ("count", "count_star"):
+        return Column(jnp.take(cnt, inv), rel.mask, rt)
+    valid = jnp.take(cnt > 0, inv)
+    return Column(jnp.take(run, inv), valid, rt, sdict=ac.sdict)

@@ -216,6 +216,27 @@ class FuncCall(Expr):
 
 
 @dataclass(eq=False)
+class WindowCall(Expr):
+    """fn() OVER (PARTITION BY ... ORDER BY ...).
+
+    Evaluated by the Window operator (≙ src/sql/engine/window_function).
+    Supported fns: row_number, rank, dense_rank, sum, count, avg, min, max
+    (ordered window aggregates use the MySQL default frame: RANGE
+    UNBOUNDED PRECEDING .. CURRENT ROW, i.e. peers share values)."""
+
+    fn: str
+    arg: "Expr | None" = None
+    partition_by: list = None
+    order_by: list = None       # list[(Expr, ascending)]
+
+    def children(self):
+        cs = [self.arg] if self.arg is not None else []
+        cs += list(self.partition_by or [])
+        cs += [e for e, _ in (self.order_by or [])]
+        return tuple(cs)
+
+
+@dataclass(eq=False)
 class AggCall(Expr):
     """Aggregate reference inside a group-by output (sum/count/min/max/avg).
 

@@ -238,6 +238,22 @@ class Binder:
             if having_bound is not None:
                 plan = pp.Filter(plan, having_bound)
                 est = max(1, est // 3)
+
+        # window functions: strip WindowCalls out of the items into a
+        # Window operator (runs after WHERE/GROUP BY/HAVING, before
+        # ORDER BY — SQL evaluation order)
+        win_specs: list = []
+
+        def strip_windows(e):
+            if isinstance(e, ir.WindowCall):
+                wcid = fresh("w")
+                win_specs.append((wcid, e))
+                return ir.col(wcid)
+            return _map_children(e, strip_windows)
+
+        items = [(strip_windows(b), name) for b, name in items]
+        if win_specs:
+            plan = pp.Window(plan, win_specs)
         # project outputs to stable names
         outputs = []
         proj = {}
@@ -689,6 +705,15 @@ class Binder:
                 raise BindError("aggregate not allowed here")
             arg = self.bind_expr(e.arg, scope) if e.arg is not None else None
             return ir.AggCall(e.fn, arg, e.distinct)
+        if isinstance(e, ir.WindowCall):
+            return ir.WindowCall(
+                e.fn,
+                self.bind_expr(e.arg, scope, allow_agg)
+                if e.arg is not None else None,
+                [self.bind_expr(p, scope, allow_agg)
+                 for p in (e.partition_by or [])],
+                [(self.bind_expr(o, scope, allow_agg), asc)
+                 for o, asc in (e.order_by or [])])
         return _map_children(
             e, lambda c: self.bind_expr(c, scope, allow_agg, qb_plan)
         )
@@ -921,6 +946,11 @@ def _map_children(e: ir.Expr, fn):
     if isinstance(e, ir.AggCall):
         return ir.AggCall(e.fn, fn(e.arg) if e.arg is not None else None,
                           e.distinct)
+    if isinstance(e, ir.WindowCall):
+        return ir.WindowCall(
+            e.fn, fn(e.arg) if e.arg is not None else None,
+            [fn(p) for p in (e.partition_by or [])],
+            [(fn(o), asc) for o, asc in (e.order_by or [])])
     return e
 
 

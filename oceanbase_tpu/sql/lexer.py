@@ -23,7 +23,7 @@ KEYWORDS = {
     "any", "some", "if", "analyze", "show", "tables", "describe", "begin",
     "commit", "rollback", "using", "natural", "recursive", "for",
     "alter", "system", "global", "session", "tenant", "freeze", "major",
-    "minor", "variables", "parameters",
+    "minor", "variables", "parameters", "over", "partition",
 }
 
 TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||"}

@@ -611,6 +611,8 @@ class Parser:
         if name == "count" and self.at_op("*"):
             self.next()
             self.expect_op(")")
+            if self.at_kw("over"):
+                return self.parse_over("count_star", [])
             return ir.AggCall("count_star")
         distinct = bool(self.accept_kw("distinct"))
         args = []
@@ -619,12 +621,42 @@ class Parser:
             while self.accept_op(","):
                 args.append(self.parse_expr())
         self.expect_op(")")
+        if self.at_kw("over"):
+            return self.parse_over(name, args)
         if name in ("count", "sum", "avg", "min", "max"):
             fn = name
             if distinct and name == "count":
                 fn = "count_distinct"
             return ir.AggCall(fn, args[0] if args else None, distinct=distinct)
         return ir.FuncCall(name, args)
+
+    def parse_over(self, name: str, args: list) -> ir.Expr:
+        self.expect_kw("over")
+        self.expect_op("(")
+        partition_by = []
+        order_by = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition_by.append(self.parse_expr())
+            while self.accept_op(","):
+                partition_by.append(self.parse_expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.parse_expr()
+                asc = True
+                if self.accept_kw("desc"):
+                    asc = False
+                else:
+                    self.accept_kw("asc")
+                order_by.append((e, asc))
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        if name == "count" and not args:
+            name = "count_star"
+        return ir.WindowCall(name, args[0] if args else None,
+                             partition_by, order_by)
 
     # ---- types / DDL / DML -------------------------------------------------
     def parse_type(self) -> SqlType:
