@@ -1,0 +1,219 @@
+"""Granule streaming: execute scan pipelines over tables larger than HBM.
+
+Reference analog: the granule iterator + pump (ObGranuleIteratorOp,
+ObGranulePump::fetch_granule_task, src/sql/engine/px/ob_granule_pump.cpp:361)
+— a scan proceeds granule-by-granule with operator rescan.  On TPU the
+granule is a fixed-shape host->HBM chunk: the chunk program compiles once
+(static shapes), the host streams chunks through it, and aggregate state
+merges via the same partial/final split the PX exchange uses.
+
+Supported pipeline shapes (the scan-agg ladder): a single-table
+TableScan/Filter/Project subtree, optionally under GroupBy or ScalarAgg,
+with Sort/Limit/Project coordinator ops on top.  Joins stream the probe
+side when the build side fits (build once, probe per granule).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oceanbase_tpu.exec import ops
+from oceanbase_tpu.exec import plan as pp
+from oceanbase_tpu.expr import ir
+from oceanbase_tpu.px.dist_ops import split_aggs
+from oceanbase_tpu.px.planner import NotDistributable, split_top
+from oceanbase_tpu.vector import Relation, from_numpy
+
+DEFAULT_CHUNK_ROWS = 1 << 21  # ~2M rows per granule
+
+
+def _find_single_scan(node):
+    """The streamed subtree must read exactly one base table."""
+    tabs = pp.referenced_tables(node)
+    if len(tabs) != 1:
+        raise NotDistributable("streaming needs a single-table subtree")
+    return next(iter(tabs))
+
+
+def execute_streamed(plan: pp.PlanNode, chunk_provider,
+                     chunk_rows: int = DEFAULT_CHUNK_ROWS,
+                     types: dict | None = None) -> Relation:
+    """Run ``plan`` by streaming the scanned table in fixed-size granules.
+
+    chunk_provider(table_name, chunk_rows) -> iterator of
+    ({col -> numpy array}, {col -> valid or None}) host chunks; must be
+    re-iterable (string columns need a dictionary pre-pass so every chunk
+    shares one encoding and the chunk program compiles exactly once).
+    """
+    top, scalar_agg, droot = split_top(plan)
+
+    # peel a GroupBy into partial (per-granule) + final (merge) phases
+    group_node = None
+    if isinstance(droot, pp.GroupBy):
+        group_node = droot
+        droot = droot.child
+    table = _find_single_scan(droot)
+
+    partial_specs = final_specs = post = None
+    keys = None
+    if group_node is not None:
+        partial_specs, final_specs, post = split_aggs(group_node.aggs)
+        keys = group_node.keys
+    elif scalar_agg is not None:
+        partial_specs, final_specs, post = split_aggs(scalar_agg.aggs)
+
+    @jax.jit
+    def chunk_fn(tables):
+        rel = pp._lower_inner(droot, tables)
+        if group_node is not None:
+            cap = min(group_node.out_capacity or 1 << 16, rel.capacity)
+            return ops.hash_groupby(rel, keys, partial_specs,
+                                    out_capacity=cap)
+        if partial_specs is not None:
+            return ops.scalar_agg(rel, partial_specs)
+        return ops.compact(rel)
+
+    # dictionary pre-pass: one global order-preserving dict per string
+    # column so all granules share an encoding (compile-once + mergeable)
+    gdicts = _global_dicts(chunk_provider, table, chunk_rows)
+
+    partials = []
+    for arrays, valids in chunk_provider(table, chunk_rows):
+        n = len(next(iter(arrays.values())))
+        if n == 0:
+            continue
+        rel = _chunk_to_relation(arrays, valids, types, gdicts, chunk_rows, n)
+        partials.append(chunk_fn({table: rel}))
+
+    if not partials:
+        raise ValueError("no granules produced")
+    merged = ops.concat(partials) if len(partials) > 1 else partials[0]
+
+    if group_node is not None:
+        rel = ops.hash_groupby(merged, {k: ir.col(k) for k in keys},
+                               final_specs,
+                               out_capacity=group_node.out_capacity)
+        outs = {k: ir.col(k) for k in keys}
+        outs.update(post)
+        rel = ops.project(rel, outs)
+    elif scalar_agg is not None:
+        rel = ops.scalar_agg(merged, final_specs)
+        rel = ops.project(rel, dict(post))
+    else:
+        rel = merged
+
+    for node in reversed(top):
+        if isinstance(node, pp.Sort):
+            rel = ops.sort_rows(rel, node.keys, node.ascending)
+        elif isinstance(node, pp.Limit):
+            rel = ops.limit(rel, node.k, node.offset)
+        elif isinstance(node, pp.Project):
+            rel = ops.project(rel, node.outputs)
+    return rel
+
+
+def _global_dicts(chunk_provider, table, chunk_rows):
+    """Pre-pass: union of unique values per string column -> sorted dict."""
+    from oceanbase_tpu.vector.column import StringDict
+
+    uniq: dict[str, np.ndarray] = {}
+    found_strings = False
+    for arrays, _valids in chunk_provider(table, chunk_rows):
+        for k, v in arrays.items():
+            if v.dtype == object or v.dtype.kind in "US":
+                found_strings = True
+                u = np.unique(v.astype(object))
+                if k in uniq:
+                    uniq[k] = np.unique(np.concatenate([uniq[k], u]))
+                else:
+                    uniq[k] = u
+        if not found_strings:
+            break  # no string columns anywhere: skip the full pre-pass
+    return {k: StringDict(v) for k, v in uniq.items()}
+
+
+def _chunk_to_relation(arrays, valids, types, gdicts, chunk_rows, n):
+    """Build a fixed-capacity device relation for one granule."""
+    from oceanbase_tpu.datatypes import SqlType
+    from oceanbase_tpu.vector.column import Column
+
+    pad = chunk_rows - n
+    numeric = {}
+    for k, v in arrays.items():
+        if k in gdicts:
+            continue
+        numeric[k] = _pad(v, pad)
+    rel = from_numpy(numeric,
+                     types={k: t for k, t in (types or {}).items()
+                            if k in numeric},
+                     valids={k: _pad(v, pad, False)
+                             for k, v in (valids or {}).items()
+                             if v is not None and k in numeric})
+    cols = dict(rel.columns)
+    for k, sd in gdicts.items():
+        if k not in arrays:
+            continue
+        codes = np.searchsorted(sd.values, arrays[k].astype(object))
+        codes = _pad(codes.astype(np.int32), pad)
+        valid = None
+        if valids and valids.get(k) is not None:
+            valid = jnp.asarray(_pad(valids[k], pad, False))
+        cols[k] = Column(jnp.asarray(codes), valid, SqlType.string(), sd)
+    mask = None
+    if pad > 0:
+        m = np.zeros(chunk_rows, dtype=bool)
+        m[:n] = True
+        mask = jnp.asarray(m)
+    return Relation(columns=cols, mask=mask)
+
+
+def _pad(v, pad, fill=0):
+    if pad <= 0 or v is None:
+        return v
+    if v.dtype == object or v.dtype.kind in "US":
+        return np.concatenate([v, np.array([""] * pad, dtype=object)])
+    return np.concatenate([v, np.full(pad, fill, dtype=v.dtype)])
+
+
+def numpy_chunk_provider(arrays: dict, valids: dict | None = None):
+    """Granules from in-memory numpy columns (bench path)."""
+
+    def provider(table, chunk_rows):
+        n = len(next(iter(arrays.values())))
+        for s in range(0, n, chunk_rows):
+            e = min(s + chunk_rows, n)
+            yield ({k: v[s:e] for k, v in arrays.items()},
+                   {k: (v[s:e] if v is not None else None)
+                    for k, v in (valids or {}).items()})
+
+    return provider
+
+
+def segment_chunk_provider(tablet, snapshot: int):
+    """Granules straight from LSM segments with zone-map chunk skipping
+    left to the caller (≙ granule = macro-block range)."""
+
+    def provider(table, chunk_rows):
+        for seg in tablet.segments:
+            if seg.min_version > snapshot:
+                continue
+            arrays, valids = seg.decode()
+            if seg.max_version > snapshot and "__version__" in arrays:
+                vis = arrays["__version__"] <= snapshot
+                arrays = {k: a[vis] for k, a in arrays.items()}
+                valids = {k: (v[vis] if v is not None else None)
+                          for k, v in valids.items()}
+            arrays = {k: a for k, a in arrays.items()
+                      if k in tablet.columns}
+            n = len(next(iter(arrays.values()))) if arrays else 0
+            for s in range(0, n, chunk_rows):
+                e = min(s + chunk_rows, n)
+                yield ({k: a[s:e] for k, a in arrays.items()},
+                       {k: (v[s:e] if v is not None else None)
+                        for k, v in valids.items() if k in tablet.columns})
+
+    return provider

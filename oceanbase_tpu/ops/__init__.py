@@ -1,0 +1,13 @@
+"""Hand-written Pallas TPU kernels for the hottest scan paths.
+
+Reference analog: the SIMD inner loops the reference hand-writes
+(white-filter SIMD src/sql/engine/basic/ob_pushdown_filter_simd.cpp,
+sum SIMD src/share/aggregate/sum_simd.h).  XLA already fuses most of the
+engine's elementwise work; these kernels exist where exactness constraints
+fight the hardware — e.g. exact decimal aggregation without emulated i64
+in the inner loop (TPU is a 32-bit machine; i64 is emulated).
+"""
+
+from oceanbase_tpu.ops.scan_kernels import q6_filter_sum
+
+__all__ = ["q6_filter_sum"]

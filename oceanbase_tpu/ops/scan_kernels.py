@@ -1,0 +1,96 @@
+"""Fused scan-filter-aggregate Pallas kernels (TPC-H Q6 shape).
+
+The Q6 hot loop is: 3 range predicates + masked sum of a product — pure
+VPU work.  The engine's generic path runs it in emulated int64 (exact
+decimals); this kernel keeps the inner loop in native int32 by splitting
+each product into (hi, lo) 16-bit halves and accumulating both as int32
+per block — exact, and sized so no 32-bit overflow is possible:
+
+    product = price(int32, <= ~2^27 cents) * discount(int32, <= 10)
+            <= ~2^31;  hi = product >> 16 <= 2^15, lo = product & 0xFFFF
+    per-block sums over BLOCK_ROWS=8192 rows:
+      sum(lo) <= 8192 * 65535 < 2^29   sum(hi) <= 8192 * 2^15 = 2^28
+
+The final reduction over per-block partials runs in int64 outside the
+kernel (tiny).  ≙ the reference's SIMD white-filter + sum fusion
+(ob_pushdown_filter_simd.cpp + sum_simd.h) re-imagined for the VPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_ROWS = 8192           # 64 sublanes x 128 lanes
+_SUB, _LANE = 64, 128
+
+
+def _q6_kernel(ship_ref, disc_ref, qty_ref, price_ref, live_ref,
+               hi_ref, lo_ref, *, ship_lo, ship_hi, disc_lo, disc_hi,
+               qty_hi):
+    ship = ship_ref[:]
+    disc = disc_ref[:]
+    qty = qty_ref[:]
+    price = price_ref[:]
+    live = live_ref[:]
+    mask = ((ship >= ship_lo) & (ship < ship_hi)
+            & (disc >= disc_lo) & (disc <= disc_hi)
+            & (qty < qty_hi) & (live != 0))
+    prod = jnp.where(mask, price * disc, 0)
+    hi = prod >> 16
+    lo = prod & 0xFFFF
+    hi_ref[0, 0] = jnp.sum(hi)
+    lo_ref[0, 0] = jnp.sum(lo)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "ship_lo", "ship_hi", "disc_lo", "disc_hi", "qty_hi", "interpret"))
+def q6_filter_sum(shipdate, discount, quantity, extendedprice, live,
+                  *, ship_lo, ship_hi, disc_lo, disc_hi, qty_hi,
+                  interpret=False):
+    """Exact fused Q6: sum(price * discount) over the filtered rows.
+
+    Inputs are int32 column arrays (any length; padded internally) plus a
+    live-row mask; returns the scale-4 fixed-point revenue as int64.
+    """
+    n = shipdate.shape[0]
+    nblocks = max((n + BLOCK_ROWS - 1) // BLOCK_ROWS, 1)
+    pad = nblocks * BLOCK_ROWS - n
+
+    def prep(x, fill=0):
+        x = x.astype(jnp.int32)
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.full(pad, fill, dtype=jnp.int32)])
+        return x.reshape(nblocks * _SUB, _LANE)
+
+    ship = prep(shipdate)
+    disc = prep(discount)
+    qty = prep(quantity, fill=qty_hi)      # padded rows fail the filter
+    price = prep(extendedprice)
+    lv = prep(live.astype(jnp.int32))
+
+    kernel = functools.partial(
+        _q6_kernel, ship_lo=ship_lo, ship_hi=ship_hi,
+        disc_lo=disc_lo, disc_hi=disc_hi, qty_hi=qty_hi)
+
+    blk = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0),
+                       memory_space=pltpu.VMEM)
+    out_blk = pl.BlockSpec((1, 1), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    hi, lo = pl.pallas_call(
+        kernel,
+        grid=(nblocks,),
+        in_specs=[blk, blk, blk, blk, blk],
+        out_specs=(out_blk, out_blk),
+        out_shape=(jax.ShapeDtypeStruct((nblocks, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((nblocks, 1), jnp.int32)),
+        interpret=interpret,
+    )(ship, disc, qty, price, lv)
+    return (jnp.sum(hi.astype(jnp.int64)) << 16) + \
+        jnp.sum(lo.astype(jnp.int64))

@@ -1,0 +1,49 @@
+"""Pallas kernel tests (interpret mode on CPU) vs numpy oracle."""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.datatypes import date_to_days
+from oceanbase_tpu.ops import q6_filter_sum
+
+
+def test_q6_kernel_exact(rng):
+    n = 100_000
+    ship = rng.integers(date_to_days("1992-01-01"),
+                        date_to_days("1998-12-01"), n).astype(np.int32)
+    disc = rng.integers(0, 11, n).astype(np.int32)
+    qty = (rng.integers(1, 51, n) * 100).astype(np.int32)
+    price = rng.integers(90_000, 10_000_000, n).astype(np.int32)
+    live = np.ones(n, dtype=np.int32)
+    live[::17] = 0  # some dead lanes
+
+    d0, d1 = date_to_days("1994-01-01"), date_to_days("1995-01-01")
+    got = int(q6_filter_sum(ship, disc, qty, price, live,
+                            ship_lo=d0, ship_hi=d1, disc_lo=5, disc_hi=7,
+                            qty_hi=2400, interpret=True))
+    sel = ((ship >= d0) & (ship < d1) & (disc >= 5) & (disc <= 7)
+           & (qty < 2400) & (live != 0))
+    want = int((price[sel].astype(np.int64) * disc[sel]).sum())
+    assert got == want
+
+
+def test_q6_kernel_ragged_and_empty(rng):
+    # non-multiple-of-block sizes and all-filtered input
+    for n in (1, 100, 8192, 8193):
+        ship = np.full(n, date_to_days("1994-06-01"), dtype=np.int32)
+        disc = np.full(n, 6, dtype=np.int32)
+        qty = np.full(n, 100, dtype=np.int32)
+        price = np.full(n, 1_000_000, dtype=np.int32)
+        live = np.ones(n, dtype=np.int32)
+        got = int(q6_filter_sum(
+            ship, disc, qty, price, live,
+            ship_lo=date_to_days("1994-01-01"),
+            ship_hi=date_to_days("1995-01-01"),
+            disc_lo=5, disc_hi=7, qty_hi=2400, interpret=True))
+        assert got == n * 6_000_000
+    # nothing matches
+    got = int(q6_filter_sum(
+        ship, disc, qty, price, live,
+        ship_lo=0, ship_hi=1, disc_lo=5, disc_hi=7, qty_hi=2400,
+        interpret=True))
+    assert got == 0
