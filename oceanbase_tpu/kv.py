@@ -1,0 +1,152 @@
+"""OBKV-style table API: key-value access bypassing the SQL compiler.
+
+Reference analog: src/libtable + src/observer/table — a typed put/get/
+delete/scan API over the same tablets and transactions as SQL, skipping
+parse/resolve/optimize for point operations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class KvTable:
+    """Point/range access to one table through the tx plane."""
+
+    def __init__(self, tenant, table: str):
+        self.tenant = tenant
+        self.table = table
+        self.ts = tenant.engine.tables[table]
+
+    def _key_of(self, key) -> tuple:
+        if isinstance(key, tuple):
+            return key
+        return (key,)
+
+    # ------------------------------------------------------------------
+    def put(self, values: dict, tx=None) -> None:
+        """Insert-or-update by primary key (≙ table api INSERT_OR_UPDATE)."""
+        tablet = self.ts.tablet
+        full = {c: values.get(c) for c in tablet.columns
+                if c != "__rowid__"}
+        key = tablet.make_key(dict(values))
+        svc = self.tenant.tx
+        own = tx is None
+        if own:
+            tx = svc.begin()
+        try:
+            # full LSM lookup (memtables AND segments): the redo/CDC op
+            # kind must reflect whether the key truly exists
+            exists = self.get(key, snapshot=tx.snapshot) is not None
+            svc.write(tx, self.table, tablet, key,
+                      "update" if exists else "insert", full)
+        except Exception:
+            if own:
+                svc.rollback(tx)
+            raise
+        if own:
+            svc.commit(tx)
+        self.tenant.catalog.invalidate(self.table)
+
+    def get(self, key, columns: Optional[list] = None,
+            snapshot: int | None = None) -> Optional[dict]:
+        """Point lookup: memtables newest-first, then segments newest-first
+        (≙ table api GET riding the LSM read path)."""
+        tablet = self.ts.tablet
+        key = self._key_of(key)
+        snap = snapshot if snapshot is not None else \
+            self.tenant.tx.gts.current()
+        for mt in [tablet.active] + tablet.frozen[::-1]:
+            v = mt.visible_version(key, snap)
+            if v is not None:
+                if v.op == "delete":
+                    return None
+                row = dict(v.values)
+                return {c: row.get(c) for c in (columns or row)}
+        # segments newest-first; rows within carry __version__/__deleted__
+        best = None
+        best_ver = -1
+        for seg in tablet.segments[::-1]:
+            if seg.min_version > snap:
+                continue
+            arrays, valids = seg.decode()
+            import numpy as np
+
+            n = len(next(iter(arrays.values()))) if arrays else 0
+            if n == 0:
+                continue
+            sel = np.ones(n, dtype=bool)
+            for kc, kv in zip(tablet.key_cols, key):
+                sel &= arrays[kc] == kv
+            if "__version__" in arrays:
+                sel &= arrays["__version__"] <= snap
+            idx = np.nonzero(sel)[0]
+            if len(idx) == 0:
+                continue
+            vers = arrays.get("__version__")
+            i = idx[-1] if vers is None else idx[np.argmax(vers[idx])]
+            ver = int(vers[i]) if vers is not None else seg.max_version
+            if ver > best_ver:
+                best_ver = ver
+                if arrays.get("__deleted__") is not None and \
+                        arrays["__deleted__"][i]:
+                    best = None
+                else:
+                    best = {}
+                    for c in tablet.columns:
+                        if c == "__rowid__" or c not in arrays:
+                            continue
+                        vd = valids.get(c)
+                        best[c] = (None if vd is not None and not vd[i]
+                                   else arrays[c][i].item()
+                                   if hasattr(arrays[c][i], "item")
+                                   else arrays[c][i])
+        if best is None:
+            return None
+        return {c: best.get(c) for c in (columns or best)}
+
+    def delete(self, key, tx=None) -> bool:
+        tablet = self.ts.tablet
+        key = self._key_of(key)
+        existing = self.get(key)
+        if existing is None:
+            return False
+        svc = self.tenant.tx
+        own = tx is None
+        if own:
+            tx = svc.begin()
+        try:
+            values = dict(existing)
+            for kc, kv in zip(tablet.key_cols, key):
+                values[kc] = kv
+            svc.write(tx, self.table, tablet, key, "delete", values)
+        except Exception:
+            if own:
+                svc.rollback(tx)
+            raise
+        if own:
+            svc.commit(tx)
+        self.tenant.catalog.invalidate(self.table)
+        return True
+
+    def scan(self, limit: int | None = None, snapshot: int | None = None):
+        """Full scan returning row dicts (range scans refine later)."""
+        tablet = self.ts.tablet
+        snap = snapshot if snapshot is not None else \
+            self.tenant.tx.gts.current()
+        arrays, valids = tablet.snapshot_arrays(snap)
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        out = []
+        for i in range(n):
+            if limit is not None and len(out) >= limit:
+                break
+            row = {}
+            for c in tablet.columns:
+                if c == "__rowid__":
+                    continue
+                vd = valids.get(c)
+                x = arrays[c][i]
+                row[c] = (None if vd is not None and not vd[i]
+                          else x.item() if hasattr(x, "item") else x)
+            out.append(row)
+        return out

@@ -123,6 +123,17 @@ class Database:
             if tenant is None or name == tenant:
                 t.checkpoint()
 
+    def backup(self, dest_root: str):
+        """Physical backup: checkpoint everything, then copy the data tree
+        (≙ data backup, src/storage/backup).  Restore = Database(dest)."""
+        if self.root is None:
+            raise ValueError("in-memory database cannot be backed up")
+        import shutil
+
+        self.checkpoint()
+        os.makedirs(os.path.dirname(dest_root) or ".", exist_ok=True)
+        shutil.copytree(self.root, dest_root, dirs_exist_ok=False)
+
     def close(self):
         self.ash.stop()
         for t in self.tenants.values():

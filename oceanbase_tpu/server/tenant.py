@@ -49,6 +49,14 @@ class Tenant:
         self.catalog = StorageCatalog(self.engine,
                                       snapshot_fn=self.tx.gts.current)
 
+        # satellites: sequences, table locks, KV/CDC front-ends
+        from oceanbase_tpu.share.sequence import SequenceManager
+        from oceanbase_tpu.tx.tablelock import LockTable
+
+        self.sequences = SequenceManager(self.engine)
+        self.locks = LockTable()
+        self.tx.lock_table = self.locks
+
         # CPU quota = bounded worker pool (≙ tenant unit min/max cpu)
         self._pool = ThreadPoolExecutor(
             max_workers=int(self.config["tenant_cpu_quota"]),
@@ -57,6 +65,18 @@ class Tenant:
         self.px_admission = threading.BoundedSemaphore(
             int(self.config["px_workers_per_tenant"]))
         self.memory_used = 0
+
+    def kv(self, table: str):
+        """OBKV-style table API handle (≙ src/libtable client)."""
+        from oceanbase_tpu.kv import KvTable
+
+        return KvTable(self, table)
+
+    def cdc(self):
+        """Change-data-capture pump over this tenant's WAL (≙ libobcdc)."""
+        from oceanbase_tpu.cdc import CdcPump
+
+        return CdcPump(self)
 
     def submit(self, fn, *args, **kwargs):
         """Queue work onto this tenant's workers (≙ tenant request queue)."""

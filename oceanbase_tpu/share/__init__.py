@@ -1,0 +1,1 @@
+"""Cross-cutting shared components (≙ src/share)."""

@@ -192,3 +192,19 @@ class TenantStmt:
 @dataclass
 class ShowStmt:
     what: str    # variables | parameters
+
+
+@dataclass
+class LockTableStmt:
+    table: str = ""
+    mode: str = "X"    # S | X; "" + unlock=True releases all
+    unlock: bool = False
+
+
+@dataclass
+class SequenceStmt:
+    op: str            # create | drop
+    name: str = ""
+    start: int = 1
+    increment: int = 1
+    cache: int = 1000

@@ -106,10 +106,11 @@ class QueryBlock:
 
 class Binder:
     def __init__(self, catalog: Catalog, ctes: dict | None = None,
-                 params: list | None = None):
+                 params: list | None = None, sequences=None):
         self.catalog = catalog
         self.ctes = dict(ctes or {})
         self.params = params or []
+        self.sequences = sequences  # SequenceManager for nextval()
 
     # ------------------------------------------------------------------
     def bind_select(self, stmt: ast.SelectStmt,
@@ -695,6 +696,15 @@ class Binder:
                             "comparisons (round 1)")
         if isinstance(e, Interval):
             raise BindError("INTERVAL outside date arithmetic")
+        if isinstance(e, ir.FuncCall) and e.name == "nextval":
+            # volatile: folded once per statement (per-row allocation only
+            # on the INSERT VALUES path)
+            if self.sequences is None:
+                raise BindError("nextval() requires a database session")
+            if len(e.args) != 1 or not isinstance(e.args[0], ir.Literal) or \
+                    not isinstance(e.args[0].value, str):
+                raise BindError("nextval() takes one sequence name literal")
+            return ir.Literal(self.sequences.nextval(e.args[0].value))
         if isinstance(e, ir.FuncCall) and e.name in ("date_add", "date_sub"):
             base = self.bind_expr(e.args[0], scope, allow_agg)
             n = e.args[1].value

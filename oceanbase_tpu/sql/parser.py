@@ -108,13 +108,34 @@ class Parser:
                 self.next()
                 self.next()
                 return ast.TenantStmt("create", self.expect_ident())
+            if self.peek(1).kind == "ident" and \
+                    self.peek(1).value == "sequence":
+                return self.parse_sequence("create")
             return self.parse_create()
         if self.at_kw("drop"):
             if self.peek(1).kind == "kw" and self.peek(1).value == "tenant":
                 self.next()
                 self.next()
                 return ast.TenantStmt("drop", self.expect_ident())
+            if self.peek(1).kind == "ident" and \
+                    self.peek(1).value == "sequence":
+                self.next()
+                self.next()
+                return ast.SequenceStmt("drop", self.expect_ident())
             return self.parse_drop()
+        if self.peek().kind == "ident" and self.peek().value == "lock":
+            self.next()
+            self.expect_kw("tables")
+            name = self.expect_ident()
+            mode_tok = self.next()
+            mode = {"read": "S", "write": "X"}.get(mode_tok.value)
+            if mode is None:
+                raise ParseError(f"expected READ or WRITE at {mode_tok.pos}")
+            return ast.LockTableStmt(name, mode)
+        if self.peek().kind == "ident" and self.peek().value == "unlock":
+            self.next()
+            self.expect_kw("tables")
+            return ast.LockTableStmt(unlock=True)
         if self.at_kw("set"):
             return self.parse_set()
         if self.at_kw("alter"):
@@ -731,6 +752,31 @@ class Parser:
             return ast.AlterSystemStmt("minor_freeze")
         t = self.peek()
         raise ParseError(f"unsupported ALTER SYSTEM at {t.pos}")
+
+    def parse_sequence(self, op: str):
+        self.next()  # create
+        self.next()  # sequence
+        name = self.expect_ident()
+        stmt = ast.SequenceStmt(op, name)
+        while self.peek().kind == "ident":
+            word = self.next().value
+            if word == "start":
+                self.accept_kw("with")
+                stmt.start = self._signed_int()
+            elif word == "increment":
+                if self.peek().kind == "kw" and self.peek().value == "by":
+                    self.next()
+                stmt.increment = self._signed_int()
+            elif word == "cache":
+                stmt.cache = self._signed_int()
+            else:
+                raise ParseError(f"unknown sequence option {word!r}")
+        return stmt
+
+    def _signed_int(self) -> int:
+        neg = bool(self.accept_op("-"))
+        v = self._int_token()
+        return -v if neg else v
 
     def parse_create(self):
         self.expect_kw("create")

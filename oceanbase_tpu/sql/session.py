@@ -247,6 +247,17 @@ class Session:
             else:
                 self.db.drop_tenant(stmt.name)
             return _ok()
+        if isinstance(stmt, ast.SequenceStmt):
+            seqs = self.tenant.sequences if self.tenant is not None else None
+            if seqs is None:
+                raise NotImplementedError("sequences need a Database")
+            if stmt.op == "create":
+                seqs.create(stmt.name, stmt.start, stmt.increment, stmt.cache)
+            else:
+                seqs.drop(stmt.name)
+            return _ok()
+        if isinstance(stmt, ast.LockTableStmt):
+            return self._lock_table(stmt)
         if isinstance(stmt, ast.ShowStmt):
             if stmt.what == "variables":
                 names = sorted(self.variables)
@@ -298,6 +309,42 @@ class Session:
             self.catalog.invalidate(name)
         return _ok()
 
+    def _lock_table(self, stmt: ast.LockTableStmt) -> Result:
+        """LOCK TABLES t READ|WRITE / UNLOCK TABLES (≙ tablelock as a tx
+        operation; MySQL-flavored syntax)."""
+        if self.tenant is None:
+            raise NotImplementedError("table locks need a Database")
+        if stmt.unlock:
+            if self._tx is not None:
+                self.tenant.locks.release_all(self._tx.tx_id)
+                if not self._tx.participants:
+                    # lock-only implicit tx: end it so later autocommit
+                    # DML doesn't silently ride (and lose) it
+                    self._txsvc.commit(self._tx)
+                    self._tx = None
+            return _ok()
+        if self._tx is None:
+            self._tx = self._txsvc.begin()  # implicit tx holds the lock
+        self.tenant.locks.acquire(stmt.table, stmt.mode, self._tx.tx_id)
+        return _ok()
+
+    def _maybe_freeze(self, table: str):
+        """Memstore-pressure freeze: active memtable beyond the configured
+        row budget flushes to L0 (≙ freeze trigger + write throttling)."""
+        if self.db is None or self.tenant is None:
+            return
+        ts = self._engine.tables.get(table)
+        if ts is None:
+            return
+        limit = int(self.tenant.config["memstore_limit_rows"])
+        if len(ts.tablet.active) >= limit:
+            self._engine.freeze_and_flush(
+                table, snapshot=self._txsvc.gts.current())
+            self.catalog.invalidate(table)
+            l0 = sum(1 for s in ts.tablet.segments if s.level == 0)
+            if l0 >= int(self.tenant.config["minor_compact_trigger"]):
+                self._engine.minor_compact(table)
+
     def _analyze(self, stmt: ast.AnalyzeStmt) -> Result:
         """Refresh optimizer stats (row counts + NDV) for a table
         (≙ DBMS_STATS gather, src/share/stat)."""
@@ -321,7 +368,8 @@ class Session:
 
     # ------------------------------------------------------------------
     def _plan_select(self, stmt: ast.SelectStmt, params):
-        binder = Binder(self.catalog, params=params or [])
+        seqs = self.tenant.sequences if self.tenant is not None else None
+        binder = Binder(self.catalog, params=params or [], sequences=seqs)
         return binder.bind_select(stmt)
 
     def _table_snapshot(self, name: str):
@@ -430,7 +478,11 @@ class Session:
     def _explain(self, stmt, params) -> Result:
         if not isinstance(stmt, ast.SelectStmt):
             raise NotImplementedError("EXPLAIN supports SELECT")
-        plan, outputs, est = self._plan_select(stmt, params)
+        # planning for EXPLAIN must not consume sequence values
+        seqs = self.tenant.sequences if self.tenant is not None else None
+        binder = Binder(self.catalog, params=params or [],
+                        sequences=_PeekSequences(seqs) if seqs else None)
+        plan, outputs, est = binder.bind_select(stmt)
         text = format_plan(plan)
         lines = np.array(text.splitlines(), dtype=object)
         return Result(["plan"], {"plan": lines}, {},
@@ -511,7 +563,9 @@ class Session:
                     raise ValueError("INSERT arity mismatch")
                 values: dict = {}
                 for c, e in zip(cols, row):
-                    v, t = literal_value(_as_literal(e, params))
+                    seqs = (self.tenant.sequences
+                            if self.tenant is not None else None)
+                    v, t = literal_value(_as_literal(e, params, seqs))
                     cdef = td.column(c)
                     values[c] = _coerce_value(v, t, cdef.dtype)
                 for c in td.columns:
@@ -541,6 +595,7 @@ class Session:
 
         self._run_in_tx(op)
         self.catalog.invalidate(stmt.table)
+        self._maybe_freeze(stmt.table)
         return _ok(rowcount=len(rows_values))
 
     def _matching_rows(self, table: str, where, params):
@@ -623,6 +678,7 @@ class Session:
 
         self._run_in_tx(op)
         self.catalog.invalidate(stmt.table)
+        self._maybe_freeze(stmt.table)
         return _ok(rowcount=n_upd)
 
     def _delete_tx(self, stmt: ast.DeleteStmt, params) -> Result:
@@ -647,6 +703,7 @@ class Session:
 
         self._run_in_tx(op)
         self.catalog.invalidate(stmt.table)
+        self._maybe_freeze(stmt.table)
         return _ok(rowcount=n_del)
 
     # ------------------------------------------------------------------
@@ -823,11 +880,14 @@ class Session:
         return _ok()
 
 
-def _as_literal(e, params) -> ir.Literal:
+def _as_literal(e, params, sequences=None) -> ir.Literal:
     if isinstance(e, ir.Literal):
         return e
     if isinstance(e, ast.Param):
         return ir.Literal(params[e.index])
+    if isinstance(e, ir.FuncCall) and e.name == "nextval" and \
+            sequences is not None:
+        return ir.Literal(sequences.nextval(e.args[0].value))
     if isinstance(e, ir.Arith) and isinstance(e.left, ir.Literal) and \
             isinstance(e.right, ir.Literal):
         lv, _ = literal_value(e.left)
@@ -855,6 +915,16 @@ def _coerce_value(v, t, target: SqlType):
     if target.kind == TypeKind.BOOL:
         return bool(v)
     return v
+
+
+class _PeekSequences:
+    """Sequence view that never advances (EXPLAIN planning)."""
+
+    def __init__(self, seqs):
+        self._seqs = seqs
+
+    def nextval(self, name: str) -> int:
+        return self._seqs.peek(name)
 
 
 def _rescale(v: int, from_scale: int, to_scale: int) -> int:

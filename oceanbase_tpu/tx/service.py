@@ -75,6 +75,7 @@ class TransService:
     def __init__(self, wal=None):
         self.gts = GTS()
         self.wal = wal            # PalfCluster or None (no replication)
+        self.lock_table = None    # tx/tablelock.LockTable when attached
         self._next_tx = itertools.count(1)
         self._live: dict[int, Transaction] = {}
         self._lock = threading.RLock()
@@ -90,6 +91,10 @@ class TransService:
               op: str, values: dict):
         if tx.state != TxState.ACTIVE:
             raise TxAborted(f"tx {tx.tx_id} is {tx.state.value}")
+        if self.lock_table is not None:
+            # implicit intent-exclusive table lock: honors LOCK TABLES
+            # READ/WRITE held by other transactions (released at tx end)
+            self.lock_table.acquire(table, "IX", tx.tx_id, timeout=5.0)
         tablet.write(key, op, values, tx.tx_id, stmt_seq=tx.stmt_seq)
         p = tx.participant(table, tablet)
         p.keys.append(key)
@@ -125,6 +130,7 @@ class TransService:
             if not parts:
                 tx.state = TxState.CLEAR
                 self._live.pop(tx.tx_id, None)
+                self._release_locks(tx)
                 return self.gts.get_ts()
             if len(parts) == 1:
                 # single-LS fast path (≙ one-phase commit optimization)
@@ -134,6 +140,7 @@ class TransService:
                 parts[0].tablet.commit(tx.tx_id, version, parts[0].keys)
                 tx.state = TxState.CLEAR
                 self._live.pop(tx.tx_id, None)
+                self._release_locks(tx)
                 return version
 
             # ---- 2PC (≙ upstream/downstream committer state machine) ----
@@ -152,6 +159,7 @@ class TransService:
                 p.state = TxState.COMMIT
             tx.state = TxState.CLEAR
             self._live.pop(tx.tx_id, None)
+            self._release_locks(tx)
             return version
 
     def rollback(self, tx: Transaction):
@@ -163,8 +171,13 @@ class TransService:
             self._log({"op": "abort", "tx": tx.tx_id})
             tx.state = TxState.ABORT
             self._live.pop(tx.tx_id, None)
+            self._release_locks(tx)
 
     # ------------------------------------------------------------------
+    def _release_locks(self, tx: Transaction):
+        if self.lock_table is not None:
+            self.lock_table.release_all(tx.tx_id)
+
     def _log(self, record: dict) -> int:
         if self.wal is not None:
             return self.wal.append([json.dumps(record).encode()])

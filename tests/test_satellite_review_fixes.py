@@ -1,0 +1,102 @@
+"""Regression tests for the satellites/streaming code-review findings."""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.tx.errors import WriteConflict
+
+
+def test_streamed_lsm_after_update_delete(tmp_path):
+    # finding 1: streamed scans must apply newest-wins + tombstones
+    from oceanbase_tpu.exec.granule import (
+        execute_streamed,
+        segment_chunk_provider,
+    )
+    from oceanbase_tpu.exec.ops import AggSpec
+    from oceanbase_tpu.exec.plan import ScalarAgg, TableScan
+    from oceanbase_tpu.expr import ir
+    from oceanbase_tpu.vector import to_numpy
+
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 5), (2, 9), (3, 100)")
+    s.execute("update t set v = 7 where k = 1")
+    s.execute("delete from t where k = 3")
+    db.checkpoint()  # multi-version L0 with tombstone
+    s.execute("update t set v = 10 where k = 2")  # newer, memtable only
+
+    plan = ScalarAgg(TableScan("t", rename={"k": "k", "v": "v"}),
+                     [AggSpec("s", "sum", ir.col("v")),
+                      AggSpec("c", "count_star")])
+    tablet = db.engine.tables["t"].tablet
+    out = to_numpy(execute_streamed(
+        plan, segment_chunk_provider(tablet, db.tx.gts.current()),
+        chunk_rows=2))
+    assert out["c"][0] == 2          # k=3 deleted
+    assert out["s"][0] == 7 + 10     # newest versions only
+    db.close()
+
+
+def test_lock_tables_blocks_dml(tmp_path):
+    # finding 3: LOCK TABLES WRITE must block other sessions' DML
+    db = Database(str(tmp_path / "db"))
+    s1, s2 = db.session(), db.session()
+    s1.execute("create table t (k int primary key)")
+    s1.execute("lock tables t write")
+    with pytest.raises(WriteConflict):
+        s2.variables["lock_timeout"] = 1
+        # DML takes an implicit IX lock that conflicts with the X lock
+        s2.execute("insert into t values (1)")
+    s1.execute("unlock tables")
+    s2.execute("insert into t values (1)")
+    # finding 2: autocommit DML after UNLOCK actually commits
+    s2_tx = s2._tx
+    assert s2_tx is None
+    assert db.session().execute("select count(*) from t").rows() == [(1,)]
+    db.close()
+
+
+def test_kv_put_after_checkpoint_is_update(tmp_path):
+    # finding 5: upsert of a flushed key must log/CDC as update
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    kv = db.tenant().kv("t")
+    pump = db.tenant().cdc()
+    kv.put({"k": 1, "v": 1})
+    db.checkpoint()
+    pump.poll()
+    kv.put({"k": 1, "v": 2})
+    events = pump.poll()
+    assert [(e.op, e.key) for e in events] == [("update", (1,))]
+    db.close()
+
+
+def test_explain_does_not_burn_sequence(tmp_path):
+    # finding 6
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create sequence sq start 5")
+    s.execute("explain select nextval('sq')")
+    assert s.execute("select nextval('sq') as v").rows() == [(5,)]
+    from oceanbase_tpu.sql.binder import BindError
+
+    with pytest.raises(BindError):
+        s.execute("select nextval()")
+    db.close()
+
+
+def test_descending_sequence(tmp_path):
+    # finding 7: negative increments use the cache properly
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create sequence down start 0 increment -2 cache 100")
+    vals = [s.execute("select nextval('down') as v").rows()[0][0]
+            for _ in range(4)]
+    assert vals == [0, -2, -4, -6]
+    # only one range allocation persisted (cache actually caches)
+    hwm = db.engine.meta["sequences"]["down"]["hwm"]
+    assert hwm == 0 - 2 * 100
+    db.close()
