@@ -53,6 +53,36 @@ def project(rel: Relation, outputs: dict[str, ir.Expr]) -> Relation:
     return Relation(columns=cols, mask=rel.mask)
 
 
+def top_n(rel: Relation, key: ir.Expr, ascending: bool, k: int) -> Relation:
+    """Fused ORDER BY <single key> LIMIT k via lax.top_k (≙ top-N sort
+    pushdown, ob_sort_vec_op top-n path).  Result rows arrive in sort
+    order; ties may order differently from the stable full sort."""
+    import jax.lax as lax
+
+    n = rel.capacity
+    m = rel.mask_or_true()
+    c = eval_expr(key, rel)
+    d = c.data
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        score = jnp.where(jnp.isnan(d), -jnp.inf, d)
+        score = -score if ascending else score
+        big = jnp.asarray(jnp.inf, score.dtype)
+        null_last = jnp.asarray(jnp.finfo(score.dtype).min, score.dtype)
+    else:
+        score = (-d.astype(jnp.int64)) if ascending else d.astype(jnp.int64)
+        big = jnp.asarray(_INT_MAX, jnp.int64)
+        null_last = -big + 1
+    if c.valid is not None:
+        # MySQL: NULL sorts smallest -> first under ASC, last under DESC;
+        # a live NULL must still outrank dead (masked) rows, so its
+        # sentinel sits strictly above the dead sentinel
+        score = jnp.where(c.valid, score, big if ascending else null_last)
+    score = jnp.where(m, score, -big)  # dead rows always lose
+    _vals, idx = lax.top_k(score, min(k, n))
+    out = rel.gather(idx, mask=jnp.take(m, idx))
+    return out
+
+
 def limit(rel: Relation, k: int, offset: int = 0) -> Relation:
     m = rel.mask_or_true()
     rank = jnp.cumsum(m.astype(jnp.int64)) - 1  # rank among live rows

@@ -219,6 +219,14 @@ def _lower_inner(node: PlanNode, tables: dict[str, Relation]) -> Relation:
     if isinstance(node, Sort):
         return ops.sort_rows(_lower(node.child, tables), node.keys, node.ascending)
     if isinstance(node, Limit):
+        child = node.child
+        if (isinstance(child, Sort) and node.offset == 0
+                and node.k <= 4096 and len(child.keys) == 1):
+            # fused top-N (single key; dictionary codes are order-preserving
+            # so string keys qualify too)
+            asc = child.ascending[0] if child.ascending else True
+            return ops.top_n(_lower(child.child, tables), child.keys[0],
+                             asc, node.k)
         return ops.limit(_lower(node.child, tables), node.k, node.offset)
     if isinstance(node, Compact):
         return ops.compact(_lower(node.child, tables), node.capacity)

@@ -1,0 +1,236 @@
+"""MySQL wire-protocol frontend.
+
+Reference analog: the obmysql protocol stack + command processors
+(deps/oblib/src/rpc/obmysql, src/observer/mysql — obmp_query, result
+drivers serializing rows to MySQL packets, ob_sync_plan_driver.cpp).
+
+Implements protocol 4.1 (text protocol): handshake v10, COM_QUERY /
+COM_PING / COM_INIT_DB / COM_QUIT, OK/ERR/EOF packets, column
+definitions and text resultset rows.  Any username/password is accepted
+(authentication plugs in later); one engine Session per connection.
+A thread per connection (≙ one ObThWorker serving the session).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+from oceanbase_tpu.datatypes import TypeKind
+
+# capability flags
+CLIENT_LONG_PASSWORD = 0x1
+CLIENT_PROTOCOL_41 = 0x200
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_PLUGIN_AUTH = 0x80000
+CLIENT_CONNECT_WITH_DB = 0x8
+CLIENT_TRANSACTIONS = 0x2000
+
+SERVER_CAPS = (CLIENT_LONG_PASSWORD | CLIENT_PROTOCOL_41 |
+               CLIENT_SECURE_CONNECTION | CLIENT_PLUGIN_AUTH |
+               CLIENT_CONNECT_WITH_DB | CLIENT_TRANSACTIONS)
+
+# column types
+T_DOUBLE, T_LONGLONG, T_DATE, T_NEWDECIMAL, T_VAR_STRING = 5, 8, 10, 246, 253
+
+
+def lenenc_int(n: int) -> bytes:
+    if n < 251:
+        return bytes([n])
+    if n < 1 << 16:
+        return b"\xfc" + struct.pack("<H", n)
+    if n < 1 << 24:
+        return b"\xfd" + struct.pack("<I", n)[:3]
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+def lenenc_str(s: bytes) -> bytes:
+    return lenenc_int(len(s)) + s
+
+
+class _Conn:
+    def __init__(self, sock: socket.socket, session):
+        self.sock = sock
+        self.session = session
+        self.seq = 0
+
+    # ---- packet framing ------------------------------------------------
+    def send(self, payload: bytes):
+        while True:
+            chunk, payload = payload[:0xFFFFFF], payload[0xFFFFFF:]
+            hdr = struct.pack("<I", len(chunk))[:3] + bytes([self.seq & 0xFF])
+            self.sock.sendall(hdr + chunk)
+            self.seq += 1
+            if len(chunk) < 0xFFFFFF:
+                break
+
+    def recv(self) -> bytes | None:
+        """Read one logical payload, reassembling >=16MB multi-packet
+        sequences (each full 0xFFFFFF chunk continues into the next)."""
+        payload = b""
+        while True:
+            hdr = self._read_n(4)
+            if hdr is None:
+                return None
+            (ln,) = struct.unpack("<I", hdr[:3] + b"\x00")
+            self.seq = hdr[3] + 1
+            chunk = self._read_n(ln)
+            if chunk is None:
+                return None
+            payload += chunk
+            if ln < 0xFFFFFF:
+                return payload
+
+    def _read_n(self, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            part = self.sock.recv(n - len(buf))
+            if not part:
+                return None
+            buf += part
+        return buf
+
+    # ---- standard packets ----------------------------------------------
+    def send_ok(self, affected=0, insert_id=0):
+        self.send(b"\x00" + lenenc_int(affected) + lenenc_int(insert_id) +
+                  struct.pack("<HH", 0x0002, 0))
+
+    def send_err(self, code: int, msg: str, state=b"HY000"):
+        self.send(b"\xff" + struct.pack("<H", code) + b"#" + state +
+                  msg.encode()[:512])
+
+    def send_eof(self):
+        self.send(b"\xfe" + struct.pack("<HH", 0, 0x0002))
+
+    # ---- handshake ------------------------------------------------------
+    def handshake(self) -> bool:
+        salt = b"0123456789abcdefghij"
+        greeting = (
+            b"\x0a" + b"5.7.0-oceanbase-tpu\x00" +
+            struct.pack("<I", threading.get_ident() & 0xFFFFFFFF) +
+            salt[:8] + b"\x00" +
+            struct.pack("<H", SERVER_CAPS & 0xFFFF) +
+            b"\x21" +                       # charset utf8
+            struct.pack("<H", 0x0002) +     # status
+            struct.pack("<H", (SERVER_CAPS >> 16) & 0xFFFF) +
+            bytes([21]) + b"\x00" * 10 + salt[8:] + b"\x00" +
+            b"mysql_native_password\x00"
+        )
+        self.seq = 0
+        self.send(greeting)
+        resp = self.recv()
+        if resp is None:
+            return False
+        # accept any credentials (auth service plugs in later)
+        self.send_ok()
+        return True
+
+    # ---- result sets ----------------------------------------------------
+    def send_resultset(self, result):
+        names = result.names
+        self.send(lenenc_int(len(names)))
+        for name in names:
+            t = result.dtypes.get(name)
+            mtype, length, decimals = self._coltype(t)
+            payload = (lenenc_str(b"def") + lenenc_str(b"") +
+                       lenenc_str(b"") + lenenc_str(b"") +
+                       lenenc_str(name.encode()) + lenenc_str(name.encode()) +
+                       b"\x0c" + struct.pack("<H", 0x21) +
+                       struct.pack("<I", length) + bytes([mtype]) +
+                       struct.pack("<H", 0) + bytes([decimals]) + b"\x00\x00")
+            self.send(payload)
+        self.send_eof()
+        for row in result.rows():
+            out = b""
+            for v in row:
+                if v is None:
+                    out += b"\xfb"
+                else:
+                    out += lenenc_str(str(v).encode())
+            self.send(out)
+        self.send_eof()
+
+    @staticmethod
+    def _coltype(t):
+        if t is None:
+            return T_VAR_STRING, 255, 0
+        if t.kind == TypeKind.DECIMAL:
+            return T_NEWDECIMAL, 20, t.scale
+        if t.kind in (TypeKind.INT, TypeKind.BOOL):
+            return T_LONGLONG, 20, 0
+        if t.kind in (TypeKind.FLOAT, TypeKind.DOUBLE):
+            return T_DOUBLE, 24, 6
+        if t.kind == TypeKind.DATE:
+            return T_DATE, 10, 0
+        return T_VAR_STRING, 255, 0
+
+    # ---- command loop ----------------------------------------------------
+    def serve(self):
+        if not self.handshake():
+            return
+        while True:
+            self.seq = 0
+            pkt = self.recv()
+            if pkt is None or not pkt:
+                return
+            cmd, arg = pkt[0], pkt[1:]
+            if cmd == 0x01:               # COM_QUIT
+                return
+            if cmd == 0x0E:               # COM_PING
+                self.send_ok()
+                continue
+            if cmd == 0x02:               # COM_INIT_DB
+                self.send_ok()
+                continue
+            if cmd == 0x03:               # COM_QUERY
+                self._handle_query(arg.decode(errors="replace"))
+                continue
+            self.send_err(1047, f"unsupported command {cmd:#x}")
+
+    def _handle_query(self, sql: str):
+        try:
+            result = self.session.execute(sql)
+        except Exception as e:  # noqa: BLE001 — protocol boundary
+            self.send_err(1064, f"{type(e).__name__}: {e}")
+            return
+        if result.names:
+            self.send_resultset(result)
+        else:
+            self.send_ok(affected=result.rowcount)
+
+
+class MySQLServer:
+    """Threaded TCP server handing each connection its own Session
+    (≙ the net frame delivering to tenant worker queues)."""
+
+    def __init__(self, database, host="127.0.0.1", port=0):
+        self.database = database
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                session = outer.database.session()
+                try:
+                    _Conn(self.request, session).serve()
+                finally:
+                    session.close()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="mysql-frontend")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

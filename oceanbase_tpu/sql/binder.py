@@ -111,6 +111,10 @@ class Binder:
         self.ctes = dict(ctes or {})
         self.params = params or []
         self.sequences = sequences  # SequenceManager for nextval()
+        # True when the bound plan embeds values computed AT BIND TIME
+        # (nextval, eagerly-executed scalar subqueries): such plans must
+        # never be cached — re-binding is what re-evaluates them
+        self.folded_volatile = False
 
     # ------------------------------------------------------------------
     def bind_select(self, stmt: ast.SelectStmt,
@@ -303,6 +307,7 @@ class Binder:
         Used where the subquery sits above an aggregation (HAVING), where
         the cross-join rewrite would have to thread through the agg."""
         if isinstance(e, ast.Subquery) and e.kind == "scalar":
+            self.folded_volatile = True  # value depends on current data
             plan, outs, _ = self.bind_select(e.select)
             from oceanbase_tpu.exec.plan import execute_plan, referenced_tables
 
@@ -704,6 +709,7 @@ class Binder:
             if len(e.args) != 1 or not isinstance(e.args[0], ir.Literal) or \
                     not isinstance(e.args[0].value, str):
                 raise BindError("nextval() takes one sequence name literal")
+            self.folded_volatile = True
             return ir.Literal(self.sequences.nextval(e.args[0].value))
         if isinstance(e, ir.FuncCall) and e.name in ("date_add", "date_sub"):
             base = self.bind_expr(e.args[0], scope, allow_agg)

@@ -372,6 +372,25 @@ class Session:
         binder = Binder(self.catalog, params=params or [], sequences=seqs)
         return binder.bind_select(stmt)
 
+    def _plan_select_cached(self, sql_key: str, stmt, params):
+        """Plan-cache probe (≙ ObPlanCache::get_plan): bound plans keyed by
+        statement text + schema version; parameter values bind as literals
+        so parameterized statements share one entry only when identical.
+        Plans that folded volatile or data-dependent values at bind time
+        (nextval, eagerly-executed scalar subqueries) never cache."""
+        key = (sql_key, tuple(params or []), self.catalog.schema_version)
+        hit = self.plan_cache.get(key)
+        if hit is not None:
+            return hit
+        seqs = self.tenant.sequences if self.tenant is not None else None
+        binder = Binder(self.catalog, params=params or [], sequences=seqs)
+        out = binder.bind_select(stmt)
+        if not binder.folded_volatile:
+            if len(self.plan_cache) > 512:
+                self.plan_cache.clear()  # crude eviction; LRU later
+            self.plan_cache[key] = out
+        return out
+
     def _table_snapshot(self, name: str):
         """Read a table at the right snapshot: an active transaction sees
         its own writes plus its begin-snapshot; otherwise latest committed
@@ -384,7 +403,14 @@ class Session:
     def _execute_select(self, stmt: ast.SelectStmt, params) -> Result:
         from oceanbase_tpu.exec.plan import referenced_tables
 
-        plan, outputs, _est = self._plan_select(stmt, params)
+        use_cache = (self.db is not None
+                     and bool(self.db.config["enable_plan_cache"])
+                     and self._ash_state.get("sql"))
+        if use_cache:
+            plan, outputs, _est = self._plan_select_cached(
+                self._ash_state["sql"], stmt, params)
+        else:
+            plan, outputs, _est = self._plan_select(stmt, params)
         tables = {t: self._table_snapshot(t)
                   for t in referenced_tables(plan)
                   if self.catalog.has_table(t)}

@@ -1,0 +1,76 @@
+"""Vector index tests: exact parity + IVF recall (≙ vector-index tests)."""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.share.vector_index import IvfFlatIndex, exact_search
+
+
+def test_exact_search_matches_numpy(rng):
+    n, d, q, k = 2000, 64, 10, 5
+    vecs = rng.normal(size=(n, d)).astype(np.float32)
+    queries = rng.normal(size=(q, d)).astype(np.float32)
+    _, idx = exact_search(queries, vecs, k, metric="l2")
+    idx = np.asarray(idx)
+    d2 = ((queries[:, None, :] - vecs[None, :, :]) ** 2).sum(-1)
+    want = np.argsort(d2, axis=1)[:, :k]
+    # same top-k sets (tie order may differ)
+    for i in range(q):
+        assert set(idx[i]) == set(want[i])
+
+
+def test_exact_cosine_and_ip(rng):
+    n, d = 500, 32
+    vecs = rng.normal(size=(n, d)).astype(np.float32)
+    qs = rng.normal(size=(3, d)).astype(np.float32)
+    _, ip_idx = exact_search(qs, vecs, 3, metric="ip")
+    want = np.argsort(-(qs @ vecs.T), axis=1)[:, :3]
+    assert set(np.asarray(ip_idx)[0]) == set(want[0])
+    _, cos_idx = exact_search(qs, vecs, 3, metric="cosine")
+    vn = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+    qn = qs / np.linalg.norm(qs, axis=1, keepdims=True)
+    want = np.argsort(-(qn @ vn.T), axis=1)[:, :3]
+    assert set(np.asarray(cos_idx)[0]) == set(want[0])
+
+
+def test_ivf_recall(rng):
+    # clustered data: IVF with a few probes should have high recall
+    n_clusters, per, d = 20, 200, 32
+    centers = rng.normal(size=(n_clusters, d)) * 10
+    vecs = np.concatenate([
+        c + rng.normal(size=(per, d)) for c in centers
+    ]).astype(np.float32)
+    queries = (centers[:5] + rng.normal(size=(5, d)) * 0.5).astype(np.float32)
+
+    idx = IvfFlatIndex(vecs, n_clusters=32, metric="l2", seed=1)
+    _, approx = idx.search(queries, k=10, nprobe=8)
+    _, exact = exact_search(queries, vecs, 10, metric="l2")
+    approx, exact = np.asarray(approx), np.asarray(exact)
+    recall = np.mean([
+        len(set(approx[i]) & set(exact[i])) / 10 for i in range(len(queries))
+    ])
+    assert recall >= 0.9, recall
+
+
+def test_ivf_small_inputs(rng):
+    vecs = rng.normal(size=(5, 8)).astype(np.float32)
+    idx = IvfFlatIndex(vecs, n_clusters=2)
+    _, got = idx.search(vecs[:2], k=3, nprobe=2)
+    assert np.asarray(got).shape == (2, 3)
+    # query for its own vector finds itself first
+    assert np.asarray(got)[0, 0] == 0
+
+
+def test_ivf_padding_reports_minus_one(rng):
+    # k exceeding the probed candidates must yield -1, not vector 0
+    vecs = np.concatenate([
+        np.zeros((3, 4)), np.full((50, 4), 100.0)
+    ]).astype(np.float32)
+    idx = IvfFlatIndex(vecs, n_clusters=2, seed=3)
+    scores, got = idx.search(np.zeros((1, 4), np.float32), k=10, nprobe=1)
+    got = np.asarray(got)[0]
+    scores = np.asarray(scores)[0]
+    pad = np.isneginf(scores)
+    assert pad.any()
+    assert (got[pad] == -1).all()
+    assert set(got[~pad]) == {0, 1, 2}
