@@ -462,8 +462,11 @@ def _eval_arith(e: ir.Arith, rel: Relation, n: int) -> Column:
     elif e.op == "-":
         data = da - db
     elif e.op == "%":
+        # MySQL MOD: truncated division — result carries the dividend's sign
         zero = db == 0
-        data = jnp.where(zero, 0, jnp.remainder(da, jnp.where(zero, 1, db)))
+        safe = jnp.where(zero, 1, db)
+        data = jnp.sign(da) * jnp.remainder(jnp.abs(da), jnp.abs(safe))
+        data = jnp.where(zero, 0, data)
         v = valid if valid is not None else _all_valid(n)
         return Column(data=data, valid=v & ~zero, dtype=ct)
     else:  # pragma: no cover
@@ -578,17 +581,164 @@ def _div_round(x, d: int):
     return jnp.where(x >= 0, (x + half) // d, -((-x + half) // d))
 
 
+def days_from_civil(y, m, d):
+    """Inverse of civil_from_days (Hinnant, floor-division form)."""
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = m + jnp.where(m > 2, -3, 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _days_in_month(y, m):
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    lengths = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31])
+    base = lengths[jnp.clip(m - 1, 0, 11)]
+    return jnp.where((m == 2) & leap, 29, base)
+
+
 def _eval_func(e: ir.FuncCall, rel: Relation, n: int) -> Column:
     name = e.name.lower()
-    if name in ("extract_year", "year", "extract_month", "month", "extract_day"):
+    if name in ("extract_year", "year", "extract_month", "month",
+                "extract_day", "day", "quarter", "dayofyear", "dayofweek",
+                "weekday"):
         c = eval_expr(e.args[0], rel)
         y, m, d = civil_from_days(c.data)
-        out = {"extract_year": y, "year": y, "extract_month": m,
-               "month": m, "extract_day": d}[name]
+        if name in ("extract_year", "year"):
+            out = y
+        elif name in ("extract_month", "month"):
+            out = m
+        elif name in ("extract_day", "day"):
+            out = d
+        elif name == "quarter":
+            out = (m + 2) // 3
+        elif name == "dayofyear":
+            out = c.data.astype(jnp.int64) - days_from_civil(
+                y, jnp.ones_like(m), jnp.ones_like(d)) + 1
+        elif name == "dayofweek":   # MySQL: 1 = Sunday
+            out = jnp.remainder(c.data.astype(jnp.int64) + 4, 7) + 1
+        else:                       # weekday: 0 = Monday
+            out = jnp.remainder(c.data.astype(jnp.int64) + 3, 7)
         return Column(data=out, valid=c.valid, dtype=SqlType.int_())
+    if name == "add_months":
+        c = eval_expr(e.args[0], rel)
+        k = eval_expr(e.args[1], rel)
+        y, m, d = civil_from_days(c.data)
+        total = y * 12 + (m - 1) + k.data.astype(jnp.int64)
+        y2 = jnp.floor_divide(total, 12)
+        m2 = total - y2 * 12 + 1
+        d2 = jnp.minimum(d, _days_in_month(y2, m2))
+        out = days_from_civil(y2, m2, d2).astype(jnp.int32)
+        return Column(data=out, valid=_merge_valid(c, k), dtype=c.dtype)
+    if name == "datediff":
+        a = eval_expr(e.args[0], rel)
+        b = eval_expr(e.args[1], rel)
+        data = a.data.astype(jnp.int64) - b.data.astype(jnp.int64)
+        return Column(data=data, valid=_merge_valid(a, b),
+                      dtype=SqlType.int_())
     if name == "abs":
         c = eval_expr(e.args[0], rel)
         return c.with_data(jnp.abs(c.data))
+    if name == "sign":
+        c = eval_expr(e.args[0], rel)
+        return Column(jnp.sign(c.data).astype(jnp.int64), c.valid,
+                      SqlType.int_())
+    if name in ("ceil", "ceiling", "floor"):
+        c = eval_expr(e.args[0], rel)
+        if c.dtype.kind == TypeKind.DECIMAL:
+            s = _POW10[c.dtype.scale]
+            if name == "floor":
+                data = jnp.floor_divide(c.data, s)
+            else:
+                data = -jnp.floor_divide(-c.data, s)
+            return Column(data, c.valid, SqlType.int_())
+        if c.dtype.kind == TypeKind.INT:
+            return c
+        f = jnp.floor if name == "floor" else jnp.ceil
+        return Column(f(c.data).astype(jnp.int64), c.valid, SqlType.int_())
+    if name in ("round", "truncate"):
+        c = eval_expr(e.args[0], rel)
+        nd = 0
+        if len(e.args) > 1:
+            nd = e.args[1].value if isinstance(e.args[1], ir.Literal) else 0
+        if c.dtype.kind == TypeKind.DECIMAL:
+            target = SqlType(TypeKind.DECIMAL, c.dtype.precision,
+                             max(nd, 0))
+            if name == "round":
+                return cast_column(c, target)
+            if nd >= c.dtype.scale:
+                return c
+            d = _POW10[c.dtype.scale - max(nd, 0)]
+            data = jnp.where(c.data >= 0, c.data // d, -((-c.data) // d))
+            return Column(data, c.valid, target)
+        if c.dtype.kind == TypeKind.INT:
+            return c
+        scale = 10.0 ** nd
+        if name == "round":
+            data = jnp.round(c.data * scale) / scale
+        else:
+            data = jnp.trunc(c.data * scale) / scale
+        return Column(data, c.valid, c.dtype)
+    if name in ("sqrt", "exp", "ln", "log2", "log10", "sin", "cos", "tan"):
+        c = _to_float(eval_expr(e.args[0], rel), TypeKind.DOUBLE)
+        fns = {"sqrt": jnp.sqrt, "exp": jnp.exp, "ln": jnp.log,
+               "log2": jnp.log2, "log10": jnp.log10, "sin": jnp.sin,
+               "cos": jnp.cos, "tan": jnp.tan}
+        data = fns[name](c.data)
+        bad = jnp.isnan(data) | jnp.isinf(data)
+        v = c.valid_or_true() & ~bad
+        return Column(data, v, SqlType.double())
+    if name in ("power", "pow"):
+        a = _to_float(eval_expr(e.args[0], rel), TypeKind.DOUBLE)
+        b = _to_float(eval_expr(e.args[1], rel), TypeKind.DOUBLE)
+        data = jnp.power(a.data, b.data)
+        return Column(data, _merge_valid(a, b), SqlType.double())
+    if name == "mod":
+        return _eval_arith(ir.Arith("%", e.args[0], e.args[1]), rel, n)
+    if name in ("greatest", "least"):
+        cols = [eval_expr(a, rel) for a in e.args]
+        cols, rt, sdict = _unify_branches(cols)
+        opf = jnp.maximum if name == "greatest" else jnp.minimum
+        data = cols[0].data
+        valid = cols[0].valid
+        for c in cols[1:]:
+            data = opf(data, c.data)
+            valid = _merge_valid(Column(data, valid, rt),
+                                 c)
+        return Column(data, valid, rt, sdict=sdict)
+    if name == "ifnull":
+        return _eval_func(ir.FuncCall("coalesce", e.args), rel, n)
+    if name == "nullif":
+        a = eval_expr(e.args[0], rel)
+        eq = _eval_cmp(ir.Cmp("=", e.args[0], e.args[1]), rel, n)
+        t, _f = _tf(eq)
+        v = a.valid_or_true() & ~t
+        return Column(a.data, v, a.dtype, a.sdict)
+    if name in ("length", "char_length", "character_length"):
+        c = eval_expr(e.args[0], rel)
+        assert c.sdict is not None, f"{name} requires a string column"
+        lut = jnp.asarray(c.sdict.lut(len).astype("int64"))
+        data = lut[jnp.clip(c.data, 0, c.sdict.size - 1)]
+        return Column(data, c.valid, SqlType.int_())
+    if name in ("trim", "ltrim", "rtrim", "reverse"):
+        fns = {"trim": str.strip, "ltrim": str.lstrip,
+               "rtrim": str.rstrip, "reverse": lambda s: s[::-1]}
+        return _dict_transform(e.args[0], rel, fns[name])
+    if name == "replace":
+        old = e.args[1].value
+        new = e.args[2].value
+        return _dict_transform(e.args[0], rel,
+                               lambda s: s.replace(old, new))
+    if name in ("left", "right"):
+        k = e.args[1].value
+        if name == "left":
+            return _dict_transform(e.args[0], rel, lambda s: s[:k])
+        return _dict_transform(e.args[0], rel,
+                               lambda s: s[-k:] if k else "")
+    if name == "concat":
+        return _eval_concat(e, rel, n)
     if name == "coalesce":
         cols = [eval_expr(a, rel) for a in e.args]
         cols, rt, sdict = _unify_branches(cols)
@@ -602,6 +752,46 @@ def _eval_func(e: ir.FuncCall, rel: Relation, n: int) -> Column:
     if name in ("substring", "substr", "upper", "lower"):
         return _dict_string_func(name, e, rel)
     raise NotImplementedError(f"function {name}")
+
+
+def _dict_transform(arg: ir.Expr, rel: Relation, fn) -> Column:
+    """Apply a host string function through the dictionary (LUT + remap)."""
+    c = eval_expr(arg, rel)
+    assert c.sdict is not None, "string function requires dict column"
+    mapped = c.sdict.lut(fn)
+    new_values, inv = np.unique(mapped.astype(object), return_inverse=True)
+    remap = jnp.asarray(inv.astype(np.int32))
+    codes = remap[jnp.clip(c.data, 0, c.sdict.size - 1)]
+    return Column(codes, c.valid, SqlType.string(), StringDict(new_values))
+
+
+_CONCAT_DICT_LIMIT = 1 << 20
+
+
+def _eval_concat(e: ir.FuncCall, rel: Relation, n: int) -> Column:
+    """CONCAT over dict columns/literals.  Column x column concatenation
+    materializes the code-pair product dictionary, guarded by a size cap
+    (beyond it the planner should pre-aggregate — r2)."""
+    cols = [eval_expr(a, rel) for a in e.args]
+    out = cols[0]
+    for c in cols[1:]:
+        if out.sdict is None or c.sdict is None:
+            raise NotImplementedError("concat requires string operands")
+        if out.sdict.size * c.sdict.size > _CONCAT_DICT_LIMIT:
+            raise NotImplementedError(
+                "concat dictionary product too large (round-1 limit)")
+        pairs = np.char.add(
+            np.repeat(out.sdict.values.astype(str), c.sdict.size),
+            np.tile(c.sdict.values.astype(str), out.sdict.size),
+        ).astype(object)
+        new_values, inv = np.unique(pairs, return_inverse=True)
+        remap = jnp.asarray(inv.astype(np.int32)).reshape(
+            out.sdict.size, c.sdict.size)
+        codes = remap[jnp.clip(out.data, 0, out.sdict.size - 1),
+                      jnp.clip(c.data, 0, c.sdict.size - 1)]
+        out = Column(codes, _merge_valid(out, c), SqlType.string(),
+                     StringDict(new_values))
+    return out
 
 
 def _dict_string_func(name: str, e: ir.FuncCall, rel: Relation) -> Column:

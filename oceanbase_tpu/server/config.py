@@ -113,6 +113,8 @@ DEF("sql_audit_queue_size", 10000, "int",
     "ring-buffer capacity of gv$sql_audit", _pos)
 DEF("enable_defensive_check", True, "bool",
     "extra engine invariant checks (≙ _enable_defensive_check)")
+DEF("lock_wait_timeout_s", 5.0, "float",
+    "implicit DML table-lock wait budget (≙ lock_wait_timeout)", _pos)
 
 
 class Config:

@@ -56,6 +56,16 @@ class Tenant:
         self.sequences = SequenceManager(self.engine)
         self.locks = LockTable()
         self.tx.lock_table = self.locks
+        self.tx.lock_wait_timeout_s = float(
+            self.config["lock_wait_timeout_s"])
+
+        def _on_cfg(k, v):
+            if k == "lock_wait_timeout_s":
+                self.tx.lock_wait_timeout_s = float(v)
+
+        # hot-reload from the tenant overlay AND the cluster config
+        self.config.watch(_on_cfg)
+        cluster_config.watch(_on_cfg)
 
         # CPU quota = bounded worker pool (≙ tenant unit min/max cpu)
         self._pool = ThreadPoolExecutor(

@@ -596,6 +596,10 @@ class Parser:
             return ir.ColumnRef(unit)
         if self.at_kw("exists"):
             return self.parse_predicate()
+        if self.at_kw("left", "right") and self.peek(1).kind == "op" and \
+                self.peek(1).value == "(":
+            # LEFT(s, n) / RIGHT(s, n) string functions
+            return self.parse_func_call(self.next().value)
         # non-reserved ("soft") keywords usable as identifiers in
         # expression position (≙ MySQL non-reserved words)
         t = self.peek()

@@ -76,6 +76,7 @@ class TransService:
         self.gts = GTS()
         self.wal = wal            # PalfCluster or None (no replication)
         self.lock_table = None    # tx/tablelock.LockTable when attached
+        self.lock_wait_timeout_s = 5.0
         self._next_tx = itertools.count(1)
         self._live: dict[int, Transaction] = {}
         self._lock = threading.RLock()
@@ -94,7 +95,8 @@ class TransService:
         if self.lock_table is not None:
             # implicit intent-exclusive table lock: honors LOCK TABLES
             # READ/WRITE held by other transactions (released at tx end)
-            self.lock_table.acquire(table, "IX", tx.tx_id, timeout=5.0)
+            self.lock_table.acquire(table, "IX", tx.tx_id,
+                                    timeout=self.lock_wait_timeout_s)
         tablet.write(key, op, values, tx.tx_id, stmt_seq=tx.stmt_seq)
         p = tx.participant(table, tablet)
         p.keys.append(key)

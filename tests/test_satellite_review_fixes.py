@@ -44,9 +44,9 @@ def test_lock_tables_blocks_dml(tmp_path):
     db = Database(str(tmp_path / "db"))
     s1, s2 = db.session(), db.session()
     s1.execute("create table t (k int primary key)")
+    s1.execute("alter system set lock_wait_timeout_s = 0.3")
     s1.execute("lock tables t write")
     with pytest.raises(WriteConflict):
-        s2.variables["lock_timeout"] = 1
         # DML takes an implicit IX lock that conflicts with the X lock
         s2.execute("insert into t values (1)")
     s1.execute("unlock tables")
