@@ -217,6 +217,9 @@ def _segment_agg(fn: str, data, weight, gid, num_segments, dtype):
     raise ValueError(fn)
 
 
+LOWCARD_GROUP_LIMIT = 4096
+
+
 def hash_groupby(
     rel: Relation,
     group_by: dict[str, ir.Expr],
@@ -226,11 +229,25 @@ def hash_groupby(
 ):
     """Vectorized GROUP BY via sort + segment reduce.
 
+    Fast path: when every group key is dictionary-encoded (or bool) and
+    the code-space product is small, the group id IS the combined code —
+    no sort at all, just one segment-reduce with a static segment count
+    (the dictionary makes cardinality a compile-time fact; ≙ the
+    reference's groupby pushdown on dict-encoded columns,
+    ob_cg_group_by_scanner).  Q1's 6-group aggregate over 6M rows skips
+    the 6M-row lexsort entirely.
+
     Output relation: one row per group, capacity = min(n, out_capacity),
     mask marks real groups.  With no group keys use scalar_agg instead.
     """
     n = rel.capacity
     m = rel.mask_or_true()
+
+    fast = _lowcard_groupby(rel, group_by, aggs, out_capacity, n, m)
+    if fast is not None:
+        if return_overflow:
+            return fast, jnp.zeros((), dtype=jnp.int64)
+        return fast
 
     key_cols = {name: eval_expr(e, rel) for name, e in group_by.items()}
     # canonicalize NULL payloads so all NULLs of a key share one group
@@ -340,6 +357,112 @@ def hash_groupby(
     if return_overflow:
         return result, gb_overflow
     return result
+
+
+def _lowcard_groupby(rel, group_by, aggs, out_capacity, n, m):
+    """Direct-code group-by; None when ineligible (falls back to sort)."""
+    key_cols = {}
+    sizes = []
+    for name, e in group_by.items():
+        c = eval_expr(e, rel)
+        if c.dtype.kind == TypeKind.BOOL:
+            size = 2
+        elif c.sdict is not None:
+            size = c.sdict.size
+        else:
+            return None
+        nullable = c.valid is not None
+        key_cols[name] = (c, size, nullable)
+        sizes.append(size + (1 if nullable else 0))
+    if not key_cols:
+        return None
+    prod = 1
+    for s in sizes:
+        prod *= s
+        if prod > LOWCARD_GROUP_LIMIT:
+            return None
+    if any(a.fn == "count_distinct" for a in aggs):
+        return None
+    if out_capacity is not None and out_capacity < prod:
+        return None
+
+    # combined group id (lexicographic in key order, so output ordering
+    # matches the sort-based path: dictionary codes are order-preserving)
+    gid = jnp.zeros(n, dtype=jnp.int64)
+    for (name, (c, size, nullable)), span in zip(key_cols.items(), sizes):
+        code = c.data.astype(jnp.int64)
+        if c.dtype.kind == TypeKind.BOOL:
+            code = c.data.astype(jnp.int64)
+        if nullable:
+            # NULL gets its own slot BELOW real codes (NULL sorts first)
+            code = jnp.where(c.valid, code + 1, 0)
+        gid = gid * span + jnp.clip(code, 0, span - 1)
+    gid = jnp.where(m, gid, prod)  # dead rows -> spill slot
+    nseg = prod + 1
+
+    out_cols: dict[str, Column] = {}
+    counts = jax.ops.segment_sum(m.astype(jnp.int64), gid,
+                                 num_segments=nseg)[:prod]
+    occupied = counts > 0
+
+    # decode group ids back into per-key code columns
+    rem = jnp.arange(prod, dtype=jnp.int64)
+    decoded = {}
+    for (name, (c, size, nullable)), span in reversed(
+            list(zip(key_cols.items(), sizes))):
+        code = rem % span
+        rem = rem // span
+        if nullable:
+            valid = code > 0
+            data = jnp.clip(code - 1, 0, max(size - 1, 0))
+        else:
+            valid = None
+            data = code
+        decoded[name] = Column(data.astype(c.data.dtype), valid, c.dtype,
+                               c.sdict)
+    for name in key_cols:
+        out_cols[name] = decoded[name]
+
+    for spec in aggs:
+        if spec.fn == "count_star":
+            out_cols[spec.name] = Column(counts, None, SqlType.int_())
+            continue
+        ac = eval_expr(spec.arg, rel)
+        if ac.dtype.kind == TypeKind.BOOL:
+            ac = cast_column(ac, SqlType.int_())
+        weight = m if ac.valid is None else (m & ac.valid)
+        cnt = jax.ops.segment_sum(weight.astype(jnp.int64), gid,
+                                  num_segments=nseg)[:prod]
+        if spec.fn == "count":
+            out_cols[spec.name] = Column(cnt, None, SqlType.int_())
+            continue
+        if spec.fn in ("sum", "avg"):
+            d = jnp.where(weight, ac.data, jnp.zeros((), ac.data.dtype))
+            s = jax.ops.segment_sum(d, gid, num_segments=nseg)[:prod]
+            if spec.fn == "sum":
+                out_cols[spec.name] = Column(
+                    s, cnt > 0, _agg_result_type("sum", ac.dtype))
+            else:
+                if ac.dtype.kind == TypeKind.DECIMAL:
+                    num = s.astype(jnp.float64) / (10 ** ac.dtype.scale)
+                else:
+                    num = s.astype(jnp.float64)
+                res = num / jnp.maximum(cnt, 1).astype(jnp.float64)
+                out_cols[spec.name] = Column(res, cnt > 0, SqlType.double())
+            continue
+        if spec.fn in ("min", "max"):
+            ident = _agg_identity(spec.fn, ac.data.dtype)
+            d = jnp.where(weight, ac.data, ident)
+            segf = jax.ops.segment_min if spec.fn == "min" \
+                else jax.ops.segment_max
+            res = segf(d, gid, num_segments=nseg)[:prod]
+            out_cols[spec.name] = Column(
+                res, cnt > 0, _agg_result_type(spec.fn, ac.dtype),
+                sdict=ac.sdict)
+            continue
+        return None  # unsupported agg: caller falls back to sort path
+
+    return Relation(columns=out_cols, mask=occupied)
 
 
 def _count_distinct(minor_to_major, order, s_data, s_valid, s_live,

@@ -33,6 +33,14 @@ class Param(ir.Expr):
 
 
 @dataclass(eq=False)
+class SysVar(ir.Expr):
+    """@@name / @@session.name / @name — session/system variable reference
+    (≙ src/share/system_variable)."""
+
+    name: str = ""
+
+
+@dataclass(eq=False)
 class Subquery(ir.Expr):
     """(SELECT ...) appearing inside an expression.
 

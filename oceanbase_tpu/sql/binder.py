@@ -74,6 +74,25 @@ class Scope:
 
 AMBIGUOUS = object()
 
+# defaults MySQL clients commonly probe on connect
+# (≙ src/share/system_variable seed values)
+_SYSVAR_DEFAULTS = {
+    "version_comment": "oceanbase-tpu",
+    "version": "5.7.0-oceanbase-tpu",
+    "sql_mode": "STRICT_TRANS_TABLES",
+    "autocommit": 1,
+    "tx_isolation": "READ-COMMITTED",
+    "transaction_isolation": "READ-COMMITTED",
+    "max_allowed_packet": 16 << 20,
+    "character_set_client": "utf8mb4",
+    "character_set_results": "utf8mb4",
+    "character_set_connection": "utf8mb4",
+    "collation_connection": "utf8mb4_general_ci",
+    "wait_timeout": 28800,
+    "interactive_timeout": 28800,
+    "lower_case_table_names": 1,
+}
+
 
 @dataclass
 class Fragment:
@@ -106,11 +125,13 @@ class QueryBlock:
 
 class Binder:
     def __init__(self, catalog: Catalog, ctes: dict | None = None,
-                 params: list | None = None, sequences=None):
+                 params: list | None = None, sequences=None,
+                 sysvars: dict | None = None):
         self.catalog = catalog
         self.ctes = dict(ctes or {})
         self.params = params or []
         self.sequences = sequences  # SequenceManager for nextval()
+        self.sysvars = sysvars      # session variables for @@refs
         # True when the bound plan embeds values computed AT BIND TIME
         # (nextval, eagerly-executed scalar subqueries): such plans must
         # never be cached — re-binding is what re-evaluates them
@@ -696,6 +717,12 @@ class Binder:
             if e.index >= len(self.params):
                 raise BindError(f"missing parameter {e.index}")
             return ir.Literal(self.params[e.index])
+        if isinstance(e, ast.SysVar):
+            v = (self.sysvars or {}).get(e.name, _SYSVAR_DEFAULTS.get(e.name))
+            if v is None:
+                raise BindError(f"unknown system variable @@{e.name}")
+            self.folded_volatile = True  # value is session state
+            return ir.Literal(v)
         if isinstance(e, ast.Subquery):
             raise BindError("subquery only supported in WHERE/HAVING "
                             "comparisons (round 1)")

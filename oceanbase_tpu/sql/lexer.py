@@ -131,6 +131,18 @@ def tokenize(sql: str) -> list[Token]:
             toks.append(Token("param", "?", i))
             i += 1
             continue
+        if c == "@":
+            j = i
+            while j < n and sql[j] == "@":
+                j += 1
+            k = j
+            while k < n and (sql[k].isalnum() or sql[k] in "_.$"):
+                k += 1
+            if k > j:
+                toks.append(Token("sysvar", sql[i:k].lower(), i))
+                i = k
+                continue
+            raise LexError(f"dangling '@' at {i}")
         if sql[i:i + 2] in TWO_CHAR_OPS:
             toks.append(Token("op", sql[i:i + 2], i))
             i += 2

@@ -498,6 +498,12 @@ class Parser:
             p = ast.Param(index=self.n_params)
             self.n_params += 1
             return p
+        if t.kind == "sysvar":
+            self.next()
+            name = t.value.lstrip("@")
+            if name.startswith(("session.", "global.")):
+                name = name.split(".", 1)[1]
+            return ast.SysVar(name)
         if t.kind == "kw":
             return self.parse_kw_primary()
         if t.kind == "ident":
@@ -735,7 +741,16 @@ class Parser:
             scope = "global"
         else:
             self.accept_kw("session")
-        name = self.expect_ident()
+        if self.peek().kind == "sysvar":
+            t = self.next()
+            name = t.value.lstrip("@")
+            if name.startswith("global."):
+                scope = "global"
+                name = name.split(".", 1)[1]
+            elif name.startswith("session."):
+                name = name.split(".", 1)[1]
+        else:
+            name = self.expect_ident()
         self.expect_op("=")
         return ast.SetVarStmt(scope, name, self._literal_value())
 

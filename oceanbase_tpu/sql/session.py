@@ -369,7 +369,8 @@ class Session:
     # ------------------------------------------------------------------
     def _plan_select(self, stmt: ast.SelectStmt, params):
         seqs = self.tenant.sequences if self.tenant is not None else None
-        binder = Binder(self.catalog, params=params or [], sequences=seqs)
+        binder = Binder(self.catalog, params=params or [], sequences=seqs,
+                        sysvars=self.variables)
         return binder.bind_select(stmt)
 
     def _plan_select_cached(self, sql_key: str, stmt, params):
@@ -383,7 +384,8 @@ class Session:
         if hit is not None:
             return hit
         seqs = self.tenant.sequences if self.tenant is not None else None
-        binder = Binder(self.catalog, params=params or [], sequences=seqs)
+        binder = Binder(self.catalog, params=params or [], sequences=seqs,
+                        sysvars=self.variables)
         out = binder.bind_select(stmt)
         if not binder.folded_volatile:
             if len(self.plan_cache) > 512:
@@ -545,10 +547,12 @@ class Session:
     # ------------------------------------------------------------------
     # transactional DML (storage/tx plane)
     # ------------------------------------------------------------------
-    def _run_in_tx(self, fn):
+    def _run_in_tx(self, fn, tx_hint=None):
         """Run fn(tx) in the active explicit transaction (with
         statement-level rollback on failure) or an autocommit one
-        (≙ implicit transactions around single statements)."""
+        (≙ implicit transactions around single statements).  ``tx_hint``
+        supplies a pre-begun autocommit transaction so the statement's
+        reads and writes share one snapshot."""
         if self._tx is not None:
             tx = self._tx
             tx.stmt_seq += 1
@@ -565,7 +569,7 @@ class Session:
                         stmt_writes[t] = new
                 self._txsvc.rollback_statement(tx, seq, stmt_writes)
                 raise
-        tx = self._txsvc.begin()
+        tx = tx_hint if tx_hint is not None else self._txsvc.begin()
         try:
             out = fn(tx)
         except Exception:
@@ -578,6 +582,15 @@ class Session:
             self._txsvc.rollback(tx)
             raise
         return out
+
+    def _stmt_tx(self):
+        """-> (tx-for-this-statement, hint): the explicit tx if one is
+        open, else a fresh autocommit tx whose snapshot the statement's
+        reads must use (pass hint on to _run_in_tx)."""
+        if self._tx is not None:
+            return self._tx, None
+        tx = self._txsvc.begin()
+        return tx, tx
 
     def _insert_tx(self, stmt: ast.InsertStmt, params) -> Result:
         td = self.catalog.table_def(stmt.table)
@@ -624,16 +637,15 @@ class Session:
         self._maybe_freeze(stmt.table)
         return _ok(rowcount=len(rows_values))
 
-    def _matching_rows(self, table: str, where, params):
-        """-> (rel, mask, tablet): snapshot relation + WHERE mask."""
+    def _matching_rows(self, table: str, where, params, tx):
+        """-> (rel, mask, tablet): relation at the statement tx's snapshot
+        + WHERE mask (reads and writes share one snapshot so the SI
+        write-conflict check is sound)."""
         from oceanbase_tpu.expr.compile import eval_predicate
         from oceanbase_tpu.sql.binder import Binder, Scope
 
         tablet = self._engine.tables[table].tablet
-        snap = (self._tx.snapshot if self._tx is not None
-                else self._txsvc.gts.current())
-        tx_id = self._tx.tx_id if self._tx is not None else 0
-        rel = self.catalog.table_data_at(table, snap, tx_id)
+        rel = self.catalog.table_data_at(table, tx.snapshot, tx.tx_id)
         binder = Binder(self.catalog, params=params or [])
         scope = Scope()
         for cname in rel.columns:
@@ -646,11 +658,20 @@ class Session:
         return rel, mask, tablet, binder, scope
 
     def _update_tx(self, stmt: ast.UpdateStmt, params) -> Result:
+        td = self.catalog.table_def(stmt.table)
+        tx, tx_hint = self._stmt_tx()
+        try:
+            return self._update_tx_body(stmt, params, td, tx, tx_hint)
+        except Exception:
+            if tx_hint is not None and tx_hint.state.value == "active":
+                self._txsvc.rollback(tx_hint)
+            raise
+
+    def _update_tx_body(self, stmt, params, td, tx, tx_hint) -> Result:
         from oceanbase_tpu.expr.compile import cast_column, eval_expr
 
-        td = self.catalog.table_def(stmt.table)
         rel, mask, tablet, binder, scope = self._matching_rows(
-            stmt.table, stmt.where, params)
+            stmt.table, stmt.where, params, tx)
         # evaluate assignments over the snapshot, then pull matched rows
         new_cols = {}
         for cname, e in stmt.assignments:
@@ -702,14 +723,23 @@ class Session:
                 self._txsvc.write(tx, stmt.table, tablet, new_key, "update",
                                  values)
 
-        self._run_in_tx(op)
+        self._run_in_tx(op, tx_hint=tx_hint)
         self.catalog.invalidate(stmt.table)
         self._maybe_freeze(stmt.table)
         return _ok(rowcount=n_upd)
 
     def _delete_tx(self, stmt: ast.DeleteStmt, params) -> Result:
+        tx, tx_hint = self._stmt_tx()
+        try:
+            return self._delete_tx_body(stmt, params, tx, tx_hint)
+        except Exception:
+            if tx_hint is not None and tx_hint.state.value == "active":
+                self._txsvc.rollback(tx_hint)
+            raise
+
+    def _delete_tx_body(self, stmt, params, tx, tx_hint) -> Result:
         rel, mask, tablet, _b, _s = self._matching_rows(
-            stmt.table, stmt.where, params)
+            stmt.table, stmt.where, params, tx)
         matched = to_numpy(rel.with_mask(mask))
         n_del = len(next(iter(matched.values()))) if matched else 0
 
@@ -727,7 +757,7 @@ class Session:
                 self._txsvc.write(tx, stmt.table, tablet, key, "delete",
                                  values)
 
-        self._run_in_tx(op)
+        self._run_in_tx(op, tx_hint=tx_hint)
         self.catalog.invalidate(stmt.table)
         self._maybe_freeze(stmt.table)
         return _ok(rowcount=n_del)

@@ -52,17 +52,30 @@ class MemTable:
     # write path (called under a transaction; ≙ mvcc_write_)
     # ------------------------------------------------------------------
     def write(self, key: tuple, op: str, values: dict, tx_id: int,
-              stmt_seq: int = 0):
+              stmt_seq: int = 0, snapshot: int | None = None):
+        """MVCC write.  With ``snapshot`` set, enforces snapshot-isolation
+        rules: first-committer-wins (a commit newer than the writer's
+        snapshot conflicts — prevents lost updates) and duplicate-key
+        rejection for inserts over a visible live row."""
         with self._lock:
             if self.frozen:
                 raise RuntimeError("memtable frozen")
             head = self._rows.get(key)
+            from oceanbase_tpu.tx.errors import DuplicateKey, WriteConflict
+
             # write-write conflict: another live tx has an uncommitted head
             if head is not None and head.commit_version == 0 and \
                     head.tx_id != tx_id:
-                from oceanbase_tpu.tx.errors import WriteConflict
-
                 raise WriteConflict(f"key {key} locked by tx {head.tx_id}")
+            if snapshot is not None and head is not None and \
+                    head.commit_version > snapshot:
+                raise WriteConflict(
+                    f"key {key} modified after snapshot {snapshot} "
+                    f"(committed at {head.commit_version})")
+            if snapshot is not None and op == "insert" and head is not None:
+                vis = self.visible_version(key, snapshot, tx_id)
+                if vis is not None and vis.op != "delete":
+                    raise DuplicateKey(f"duplicate key {key}")
             v = Version(0, tx_id, op, dict(values), prev=head,
                         stmt_seq=stmt_seq)
             self._rows[key] = v

@@ -69,9 +69,20 @@ class Tablet:
             return out
 
     def write(self, key: tuple, op: str, values: dict, tx_id: int,
-              stmt_seq: int = 0):
+              stmt_seq: int = 0, snapshot: int | None = None):
         with self._lock:
-            v = self.active.write(key, op, values, tx_id, stmt_seq)
+            # SI conflict checks look at frozen memtables too: the key's
+            # newest version may have been frozen mid-transaction
+            if snapshot is not None:
+                for mt in self.frozen:
+                    head = mt._rows.get(key)
+                    if head is not None and head.commit_version > snapshot:
+                        from oceanbase_tpu.tx.errors import WriteConflict
+
+                        raise WriteConflict(
+                            f"key {key} modified after snapshot {snapshot}")
+            v = self.active.write(key, op, values, tx_id, stmt_seq,
+                                  snapshot=snapshot)
             return v
 
     def commit(self, tx_id: int, commit_version: int, keys):

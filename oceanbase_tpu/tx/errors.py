@@ -7,3 +7,7 @@ class WriteConflict(RuntimeError):
 
 class TxAborted(RuntimeError):
     """Transaction was aborted (conflict, deadlock, or explicit rollback)."""
+
+
+class DuplicateKey(WriteConflict):
+    """INSERT over an existing visible primary key."""

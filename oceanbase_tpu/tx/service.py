@@ -97,7 +97,8 @@ class TransService:
             # READ/WRITE held by other transactions (released at tx end)
             self.lock_table.acquire(table, "IX", tx.tx_id,
                                     timeout=self.lock_wait_timeout_s)
-        tablet.write(key, op, values, tx.tx_id, stmt_seq=tx.stmt_seq)
+        tablet.write(key, op, values, tx.tx_id, stmt_seq=tx.stmt_seq,
+                     snapshot=tx.snapshot)
         p = tx.participant(table, tablet)
         p.keys.append(key)
         lsn = self._log({"op": "redo", "tx": tx.tx_id, "table": table,
