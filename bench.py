@@ -55,12 +55,46 @@ def numpy_q6(li, d0, d1):
     return (li["l_extendedprice"][sel] * li["l_discount"][sel]).sum()
 
 
+def _ensure_backend():
+    """The axon TPU tunnel can be unavailable; rather than hang or crash,
+    re-exec on CPU (the JSON line carries `platform` so the fallback is
+    transparent to the reader)."""
+    import subprocess
+
+    budget = int(os.environ.get("BENCH_TPU_TIMEOUT_S", "600"))
+    if os.environ.get("OBTPU_BENCH_FALLBACK") != "1" and \
+            os.environ.get("PALLAS_AXON_POOL_IPS"):
+        # only the axon tunnel can hang; plain CPU/TPU setups skip the probe
+        # probe in a CHILD process: a stuck tunnel blocks inside native
+        # code where no Python signal can interrupt, so the only safe
+        # timeout is process-level
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=budget, capture_output=True)
+            ok = probe.returncode == 0
+        except subprocess.TimeoutExpired:
+            ok = False
+        if not ok:
+            print("# TPU backend unavailable (probe failed/timed out); "
+                  "falling back to CPU", file=sys.stderr)
+            env = dict(os.environ)
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["OBTPU_BENCH_FALLBACK"] = "1"
+            os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+    import jax
+
+    return jax
+
+
 def main():
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
     which = os.environ.get("BENCH_QUERY", "q1")
 
-    import jax
+    jax = _ensure_backend()
 
     from oceanbase_tpu.bench.queries import q1_plan, q6_plan
     from oceanbase_tpu.bench.tpch import gen_tpch

@@ -1,0 +1,120 @@
+"""Spill-partitioned join: joins whose inputs exceed the device budget.
+
+Reference analog: the unified hash-partitioning spill infrastructure
+(ob_hp_infras_vec_op.h; recursive partition dump in
+ob_hash_join_vec_op.h:413 build_hash_table_for_recursive).  The TPU
+version: hash-partition BOTH sides on the join key on the host (numpy),
+then run each co-partition pair through the device join — each pair fits
+the device budget, partitions stream through one compiled program when
+sizes are padded to a uniform capacity.
+
+This composes with granule streaming: scan-side granules fill host
+partitions, then partitions join pairwise (out-of-HBM joins, SURVEY §7
+hard part (d)).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from oceanbase_tpu.exec import diag, ops
+from oceanbase_tpu.exec.ops import _M1, _M2  # one source for hash constants
+from oceanbase_tpu.expr import ir
+from oceanbase_tpu.vector import Relation, from_numpy, to_numpy
+
+
+def _mix64_np(x: np.ndarray) -> np.ndarray:
+    x = x.astype(np.uint64)
+    with np.errstate(over="ignore"):
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(_M1)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(_M2)
+        return x ^ (x >> np.uint64(31))
+
+
+def _partition_of(arrays: dict, keys: list[str], n_parts: int) -> np.ndarray:
+    h = np.zeros(len(next(iter(arrays.values()))), dtype=np.uint64)
+    for k in keys:
+        kv = arrays[k]
+        if kv.dtype == object or kv.dtype.kind in "US":
+            kv = np.array([hash(x) & 0xFFFFFFFFFFFFFFFF for x in kv],
+                          dtype=np.uint64)
+        h = _mix64_np(h ^ _mix64_np(kv.astype(np.int64).view(np.uint64)
+                                    if kv.dtype.kind in "iu"
+                                    else kv.astype(np.uint64)))
+    return (h % np.uint64(n_parts)).astype(np.int64)
+
+
+def partitioned_join(
+    left: dict, right: dict, left_keys: list[str], right_keys: list[str],
+    how: str = "inner", n_partitions: int = 8,
+    left_types: dict | None = None, right_types: dict | None = None,
+    out_capacity_per_part: int | None = None,
+):
+    """Join two host-resident column sets partition-by-partition.
+
+    left/right: {col -> numpy array} (column names must be disjoint,
+    as in the planner's join contract).  Returns (arrays, valids):
+    {col -> numpy array} plus {col -> bool array} for columns carrying
+    NULLs (left-join unmatched sides).  Keys hash-copartition, so every
+    match lands in the same pair; per-pair capacity overflow grows the
+    budget and redoes the pair (≙ recursive partition dump).
+    """
+    lp = _partition_of(left, left_keys, n_partitions)
+    rp = _partition_of(right, right_keys, n_partitions)
+    lkeys_e = [ir.col(k) for k in left_keys]
+    rkeys_e = [ir.col(k) for k in right_keys]
+
+    out_parts: list[dict] = []
+    for p in range(n_partitions):
+        lsel = lp == p
+        rsel = rp == p
+        la, ra = bool(lsel.any()), bool(rsel.any())
+        if not la or (how == "inner" and not ra):
+            continue
+        lrel = from_numpy({k: v[lsel] for k, v in left.items()},
+                          types=left_types)
+        rrel = (from_numpy({k: v[rsel] for k, v in right.items()},
+                           types=right_types)
+                if ra else _empty_like(right, right_types))
+        cap = out_capacity_per_part or max(int(lsel.sum()) * 2, 1024)
+        for _attempt in range(4):
+            with diag.collect() as entries:
+                j = ops.join(lrel, rrel, lkeys_e, rkeys_e, how=how,
+                             out_capacity=cap)
+                dropped = sum(int(v) for _name, v in entries)
+            if dropped == 0:
+                break
+            cap *= 4  # ≙ recursive re-partition: grow and redo this pair
+        else:
+            raise diag.CapacityOverflow(
+                f"spill partition {p} still overflows at capacity {cap}")
+        out_parts.append(to_numpy(j))
+
+    if not out_parts:
+        return {}, {}
+    cols = [c for c in out_parts[0] if not c.startswith("__valid__")]
+    arrays = {c: np.concatenate([pt[c] for pt in out_parts if c in pt])
+              for c in cols}
+    valids = {}
+    for c in cols:
+        vkey = "__valid__" + c
+        if any(vkey in pt for pt in out_parts):
+            valids[c] = np.concatenate(
+                [pt.get(vkey, np.ones(len(pt[c]), dtype=bool))
+                 for pt in out_parts])
+    return arrays, valids
+
+
+def _empty_like(arrays: dict, types):
+    one = {}
+    valids = {}
+    for k, v in arrays.items():
+        if v.dtype == object or v.dtype.kind in "US":
+            one[k] = np.array([""], dtype=object)
+        else:
+            one[k] = np.zeros(1, dtype=v.dtype)
+        valids[k] = np.array([False])
+    import jax.numpy as jnp
+
+    rel = from_numpy(one, types=types, valids=valids)
+    return Relation(columns=rel.columns, mask=jnp.zeros(1, dtype=jnp.bool_))
