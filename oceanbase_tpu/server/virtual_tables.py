@@ -38,6 +38,8 @@ class VirtualTables:
             "v$palf": self.palf,
             "v$wait_events": self.wait_events,
             "v$errsim": self.errsim,
+            "information_schema.tables": self.is_tables,
+            "information_schema.columns": self.is_columns,
         }
 
     def provide(self, name: str):
@@ -137,6 +139,36 @@ class VirtualTables:
             "last_lsn": np.array([r[4] for r in rows], np.int64),
             "committed_lsn": np.array([r[5] for r in rows], np.int64),
             "is_down": np.array([bool(r[6]) for r in rows]),
+        }
+
+    def is_tables(self):
+        rows = []
+        for tname, tenant in self.db.tenants.items():
+            for name, ts in tenant.engine.tables.items():
+                rows.append((tname, name, ts.tablet.row_count_estimate()))
+        return {
+            "table_schema": _obj(r[0] for r in rows),
+            "table_name": _obj(r[1] for r in rows),
+            "table_rows": np.array([r[2] for r in rows], np.int64),
+        }
+
+    def is_columns(self):
+        rows = []
+        for tname, tenant in self.db.tenants.items():
+            for name, ts in tenant.engine.tables.items():
+                for pos, c in enumerate(ts.tdef.columns, 1):
+                    rows.append((tname, name, c.name, pos, str(c.dtype),
+                                 "YES" if c.nullable else "NO",
+                                 "PRI" if c.name in ts.tdef.primary_key
+                                 else ""))
+        return {
+            "table_schema": _obj(r[0] for r in rows),
+            "table_name": _obj(r[1] for r in rows),
+            "column_name": _obj(r[2] for r in rows),
+            "ordinal_position": np.array([r[3] for r in rows], np.int64),
+            "data_type": _obj(r[4] for r in rows),
+            "is_nullable": _obj(r[5] for r in rows),
+            "column_key": _obj(r[6] for r in rows),
         }
 
     def wait_events(self):

@@ -155,6 +155,7 @@ class DeleteStmt:
 @dataclass
 class ExplainStmt:
     stmt: object
+    analyze: bool = False  # EXPLAIN ANALYZE: execute + per-op row counts
 
 
 @dataclass

@@ -98,7 +98,10 @@ class Parser:
     def parse_statement(self):
         if self.at_kw("explain"):
             self.next()
-            return ast.ExplainStmt(self.parse_statement())
+            analyze = bool(self.accept_kw("analyze"))
+            stmt = ast.ExplainStmt(self.parse_statement())
+            stmt.analyze = analyze
+            return stmt
         if self.at_kw("with", "select"):
             return self.parse_select()
         if self.at_op("("):
@@ -338,6 +341,9 @@ class Parser:
             self.expect_op(")")
             return inner
         name = self.expect_ident()
+        if self.accept_op("."):
+            # schema-qualified table (information_schema.tables, …)
+            name = f"{name}.{self.expect_ident()}"
         alias = None
         if self.accept_kw("as"):
             alias = self.expect_ident()

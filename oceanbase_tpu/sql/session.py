@@ -202,7 +202,8 @@ class Session:
         if isinstance(stmt, ast.SelectStmt):
             return self._execute_select(stmt, params)
         if isinstance(stmt, ast.ExplainStmt):
-            return self._explain(stmt.stmt, params)
+            return self._explain(stmt.stmt, params,
+                                 analyze=getattr(stmt, "analyze", False))
         if isinstance(stmt, ast.CreateTableStmt):
             return self._create_table(stmt)
         if isinstance(stmt, ast.DropTableStmt):
@@ -503,15 +504,29 @@ class Session:
         n = len(next(iter(arrays.values()))) if names else 0
         return Result(names, arrays, valids, dtypes, rowcount=n)
 
-    def _explain(self, stmt, params) -> Result:
+    def _explain(self, stmt, params, analyze: bool = False) -> Result:
         if not isinstance(stmt, ast.SelectStmt):
             raise NotImplementedError("EXPLAIN supports SELECT")
         # planning for EXPLAIN must not consume sequence values
         seqs = self.tenant.sequences if self.tenant is not None else None
         binder = Binder(self.catalog, params=params or [],
-                        sequences=_PeekSequences(seqs) if seqs else None)
+                        sequences=_PeekSequences(seqs) if seqs else None,
+                        sysvars=self.variables)
         plan, outputs, est = binder.bind_select(stmt)
-        text = format_plan(plan)
+        row_counts = None
+        if analyze:
+            from oceanbase_tpu.exec.plan import referenced_tables
+
+            tables = {t: self._table_snapshot(t)
+                      for t in referenced_tables(plan)
+                      if self.catalog.has_table(t)}
+            monitor: list = []
+            execute_plan(plan, tables, monitor_out=monitor)
+            # monitor entries arrive in the executor's postorder; map them
+            # back to nodes for annotation
+            row_counts = dict(zip(_postorder_ids(plan),
+                                  (cnt for _n, cnt in monitor)))
+        text = format_plan(plan, row_counts=row_counts)
         lines = np.array(text.splitlines(), dtype=object)
         return Result(["plan"], {"plan": lines}, {},
                       {"plan": SqlType.string()}, rowcount=len(lines),
@@ -995,8 +1010,17 @@ def _ok(rowcount: int = 0) -> Result:
     return Result([], {}, {}, {}, rowcount=rowcount)
 
 
-def format_plan(node, indent: int = 0) -> str:
-    """EXPLAIN output (≙ src/sql/printer plan text)."""
+def _postorder_ids(node) -> list:
+    out = []
+    for c in node.children():
+        out.extend(_postorder_ids(c))
+    out.append(id(node))
+    return out
+
+
+def format_plan(node, indent: int = 0, row_counts: dict | None = None) -> str:
+    """EXPLAIN [ANALYZE] output (≙ src/sql/printer plan text; ANALYZE adds
+    actual output rows per operator from the plan-monitor lanes)."""
     from oceanbase_tpu.exec import plan as pp
 
     pad = "  " * indent
@@ -1011,5 +1035,8 @@ def format_plan(node, indent: int = 0) -> str:
             s = s[:57] + "..."
         attrs.append(f"{k}={s}")
     line = f"{pad}{name}({', '.join(attrs)})"
+    if row_counts is not None and id(node) in row_counts:
+        line += f"  [rows={row_counts[id(node)]}]"
     kids = list(node.children())
-    return "\n".join([line] + [format_plan(c, indent + 1) for c in kids])
+    return "\n".join([line] + [format_plan(c, indent + 1, row_counts)
+                               for c in kids])

@@ -1,0 +1,38 @@
+"""EXPLAIN ANALYZE + information_schema tests."""
+
+import pytest
+
+from oceanbase_tpu.server import Database
+
+
+def test_explain_analyze_row_counts(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 1), (2, 2), (3, 3), (4, 4)")
+    r = s.execute("explain analyze select sum(v) from t where k >= 3")
+    text = r.plan_text
+    assert "TableScan" in text and "[rows=4]" in text
+    assert "Filter" in text and "[rows=2]" in text
+    assert "ScalarAgg" in text and "[rows=1]" in text
+    # plain EXPLAIN has no row annotations and does not execute
+    r = s.execute("explain select sum(v) from t")
+    assert "[rows=" not in r.plan_text
+    db.close()
+
+
+def test_information_schema(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v decimal(10,2))")
+    s.execute("insert into t values (1, 1.5)")
+    r = s.execute("select table_name, table_rows from information_schema.tables "
+                  "where table_schema = 'sys'")
+    assert ("t", 1) in r.rows()
+    r = s.execute("select column_name, data_type, column_key "
+                  "from information_schema.columns "
+                  "where table_name = 't' order by ordinal_position")
+    rows = r.rows()
+    assert rows[0] == ("k", "INT", "PRI")
+    assert rows[1][0] == "v" and "DECIMAL" in rows[1][1]
+    db.close()
