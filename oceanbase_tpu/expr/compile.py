@@ -599,8 +599,46 @@ def _days_in_month(y, m):
     return jnp.where((m == 2) & leap, 29, base)
 
 
+# ---------------------------------------------------------------------------
+# UDF registry (≙ PL/SQL + LLVM JIT, src/pl + src/objit): user functions
+# written against jax.numpy trace straight into the plan's XLA program —
+# tracing IS the JIT.
+# ---------------------------------------------------------------------------
+
+_UDFS: dict[str, tuple] = {}
+
+
+def register_udf(name: str, fn, result_type: "SqlType | None" = None):
+    """Register fn(*jnp_arrays) -> jnp_array as a SQL scalar function.
+
+    The function must be traceable (jax.numpy ops, no data-dependent
+    python control flow); NULL handling: result is NULL where any input
+    is NULL (strict functions)."""
+    _UDFS[name.lower()] = (fn, result_type)
+
+
+def unregister_udf(name: str):
+    _UDFS.pop(name.lower(), None)
+
+
 def _eval_func(e: ir.FuncCall, rel: Relation, n: int) -> Column:
     name = e.name.lower()
+    if name in _UDFS:
+        fn, rt = _UDFS[name]
+        cols = [eval_expr(a, rel) for a in e.args]
+        data = fn(*[c.data for c in cols])
+        valid = None
+        for c in cols:
+            valid = c.valid if valid is None else (
+                valid if c.valid is None else (valid & c.valid))
+        if rt is None:
+            if jnp.issubdtype(data.dtype, jnp.floating):
+                rt = SqlType.double()
+            elif data.dtype == jnp.bool_:
+                rt = SqlType.bool_()
+            else:
+                rt = SqlType.int_()
+        return Column(jnp.asarray(data), valid, rt)
     if name in ("extract_year", "year", "extract_month", "month",
                 "extract_day", "day", "quarter", "dayofyear", "dayofweek",
                 "weekday"):

@@ -211,6 +211,17 @@ class LockTableStmt:
 
 
 @dataclass
+class LoadDataStmt:
+    """LOAD DATA INFILE 'path' INTO TABLE t [FIELDS TERMINATED BY c]
+    [IGNORE n LINES] — the direct-load SQL surface."""
+
+    path: str = ""
+    table: str = ""
+    delimiter: str = ","
+    skip_lines: int = 0
+
+
+@dataclass
 class SequenceStmt:
     op: str            # create | drop
     name: str = ""

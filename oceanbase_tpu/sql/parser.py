@@ -126,6 +126,8 @@ class Parser:
                 self.next()
                 return ast.SequenceStmt("drop", self.expect_ident())
             return self.parse_drop()
+        if self.peek().kind == "ident" and self.peek().value == "load":
+            return self.parse_load_data()
         if self.peek().kind == "ident" and self.peek().value == "lock":
             self.next()
             self.expect_kw("tables")
@@ -777,6 +779,38 @@ class Parser:
             return ast.AlterSystemStmt("minor_freeze")
         t = self.peek()
         raise ParseError(f"unsupported ALTER SYSTEM at {t.pos}")
+
+    def parse_load_data(self):
+        self.next()  # load
+        if self.next().value != "data":
+            raise ParseError("expected LOAD DATA")
+        if self.next().value != "infile":
+            raise ParseError("expected INFILE")
+        t = self.next()
+        if t.kind != "string":
+            raise ParseError(f"INFILE requires a path string at {t.pos}")
+        stmt = ast.LoadDataStmt(path=t.value)
+        self.expect_kw("into")
+        self.expect_kw("table")
+        stmt.table = self.expect_ident()
+        while self.peek().kind == "ident":
+            word = self.peek().value
+            if word == "fields":
+                self.next()
+                if self.next().value != "terminated":
+                    raise ParseError("expected TERMINATED")
+                self.expect_kw("by")
+                d = self.next()
+                stmt.delimiter = d.value
+            elif word == "ignore":
+                self.next()
+                stmt.skip_lines = self._int_token()
+                if self.peek().kind == "ident" and \
+                        self.peek().value == "lines":
+                    self.next()
+            else:
+                break
+        return stmt
 
     def parse_sequence(self, op: str):
         self.next()  # create

@@ -248,6 +248,8 @@ class Session:
             else:
                 self.db.drop_tenant(stmt.name)
             return _ok()
+        if isinstance(stmt, ast.LoadDataStmt):
+            return self._load_data(stmt)
         if isinstance(stmt, ast.SequenceStmt):
             seqs = self.tenant.sequences if self.tenant is not None else None
             if seqs is None:
@@ -309,6 +311,63 @@ class Session:
                 eng.major_compact(name)
             self.catalog.invalidate(name)
         return _ok()
+
+    def _load_data(self, stmt: ast.LoadDataStmt) -> Result:
+        """LOAD DATA INFILE: CSV -> direct-load baseline segment
+        (≙ src/storage/direct_load bypassing the memtable)."""
+        import csv
+
+        td = self.catalog.table_def(stmt.table)
+        cols = [[] for _ in td.columns]
+        with open(stmt.path, newline="") as f:
+            reader = csv.reader(f, delimiter=stmt.delimiter)
+            for i, row in enumerate(reader):
+                if i < stmt.skip_lines:
+                    continue
+                if len(row) != len(td.columns):
+                    raise ValueError(
+                        f"row {i + 1}: {len(row)} fields, expected "
+                        f"{len(td.columns)}")
+                for j, cell in enumerate(row):
+                    cols[j].append(cell)
+        n = len(cols[0]) if cols else 0
+        arrays, valids = {}, {}
+        for cdef, raw in zip(td.columns, cols):
+            vals = []
+            valid = np.ones(n, dtype=bool)
+            for i, cell in enumerate(raw):
+                if cell == "" or cell.upper() == "\\N":
+                    valid[i] = False
+                    vals.append("" if cdef.dtype.is_string else 0)
+                    continue
+                if cdef.dtype.is_string:
+                    vals.append(cell)
+                elif cdef.dtype.kind == TypeKind.DECIMAL:
+                    v, t = literal_value(ir.Literal(cell, SqlType.decimal()))
+                    vals.append(_rescale(v, t.scale, cdef.dtype.scale))
+                elif cdef.dtype.kind == TypeKind.DATE:
+                    from oceanbase_tpu.datatypes import date_to_days
+
+                    vals.append(date_to_days(cell))
+                elif cdef.dtype.kind in (TypeKind.FLOAT, TypeKind.DOUBLE):
+                    vals.append(float(cell))
+                else:
+                    vals.append(int(cell))
+            arrays[cdef.name] = (np.array(vals, dtype=object)
+                                 if cdef.dtype.is_string
+                                 else np.asarray(vals,
+                                                 dtype=cdef.dtype.np_dtype))
+            if not valid.all():
+                valids[cdef.name] = valid
+        if self.db is not None:
+            self._engine.bulk_load(stmt.table, arrays, valids or None,
+                                   version=self._txsvc.gts.get_ts())
+            self.catalog.invalidate(stmt.table)
+            td.row_count = self._engine.tables[stmt.table] \
+                .tablet.row_count_estimate()
+        else:
+            raise NotImplementedError("LOAD DATA needs a Database")
+        return _ok(rowcount=n)
 
     def _lock_table(self, stmt: ast.LockTableStmt) -> Result:
         """LOCK TABLES t READ|WRITE / UNLOCK TABLES (≙ tablelock as a tx
