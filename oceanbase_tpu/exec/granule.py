@@ -39,6 +39,76 @@ def _find_single_scan(node):
     return next(iter(tabs))
 
 
+def extract_column_bounds(node) -> dict:
+    """Collect per-source-column [lo, hi] bounds from the Filter chain for
+    zone-map chunk pruning (≙ the white filters the blockscan applies on
+    index-block aggregates before decoding micro blocks).
+
+    Only top-level AND conjuncts of the shapes col cmp literal survive;
+    everything else is simply not used for pruning (safe over-approx).
+    Returns {source_col: (lo|None, hi|None)} in SOURCE column names
+    (TableScan rename reversed)."""
+    from oceanbase_tpu.expr.compile import literal_value
+
+    bounds: dict[str, list] = {}
+    rename_inv: dict[str, str] = {}
+
+    def visit(nd):
+        if isinstance(nd, pp.TableScan) and nd.rename:
+            for src, cid in nd.rename.items():
+                rename_inv[cid] = src
+        for c in nd.children():
+            visit(c)
+        if isinstance(nd, pp.Filter):
+            for conj in _conjuncts(nd.pred):
+                _one(conj)
+
+    def _conjuncts(e):
+        if isinstance(e, ir.Logic) and e.op == "and":
+            for a in e.args:
+                yield from _conjuncts(a)
+        else:
+            yield e
+
+    def _one(e):
+        if not isinstance(e, ir.Cmp):
+            return
+        col, lit_, op = None, None, e.op
+        if isinstance(e.left, ir.ColumnRef) and isinstance(e.right, ir.Literal):
+            col, lit_ = e.left.name, e.right
+        elif isinstance(e.right, ir.ColumnRef) and \
+                isinstance(e.left, ir.Literal):
+            col, lit_ = e.right.name, e.left
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}.get(op)
+        if col is None or op is None:
+            return
+        try:
+            v, t = literal_value(lit_)
+        except Exception:  # noqa: BLE001 — non-foldable literal
+            return
+        # only types whose literal representation equals the stored
+        # representation prune safely (decimal literals carry their own
+        # textual scale, which may differ from the column's)
+        if t.kind.value not in ("int", "date", "datetime", "bool"):
+            return
+        if not isinstance(v, (int, np.integer)):
+            return
+        v = int(v)
+        src = rename_inv.get(col, col)
+        lo, hi = bounds.get(src, [None, None])
+        if op in (">", ">="):
+            lo = v if lo is None else max(lo, v)
+        elif op in ("<", "<="):
+            hi = v if hi is None else min(hi, v)
+        elif op == "=":
+            lo = v if lo is None else max(lo, v)
+            hi = v if hi is None else min(hi, v)
+        bounds[src] = [lo, hi]
+
+    visit(node)
+    return {k: tuple(v) for k, v in bounds.items()}
+
+
 def execute_streamed(plan: pp.PlanNode, chunk_provider,
                      chunk_rows: int = DEFAULT_CHUNK_ROWS,
                      types: dict | None = None,
@@ -92,8 +162,12 @@ def execute_streamed(plan: pp.PlanNode, chunk_provider,
         if cache is not None:
             cache.update(key=ckey, chunk_fn=chunk_fn, gdicts=gdicts)
 
+    # zone-map pushdown: range bounds from the filter chain let providers
+    # skip whole chunks before decode/upload (≙ blockscan index-skip)
+    bounds = extract_column_bounds(droot)
+
     partials = []
-    for arrays, valids in chunk_provider(table, chunk_rows):
+    for arrays, valids in chunk_provider(table, chunk_rows, bounds):
         n = len(next(iter(arrays.values())))
         if n == 0:
             continue
@@ -101,7 +175,19 @@ def execute_streamed(plan: pp.PlanNode, chunk_provider,
         partials.append(chunk_fn({table: rel}))
 
     if not partials:
-        raise ValueError("no granules produced")
+        # zone maps pruned everything: synthesize one all-dead granule so
+        # aggregates produce their correct empty-input results
+        try:
+            arrays, valids = next(iter(
+                chunk_provider(table, chunk_rows, None)))
+        except StopIteration:
+            raise ValueError("no granules produced") from None
+        n = len(next(iter(arrays.values())))
+        rel = _chunk_to_relation(arrays, valids, types, gdicts,
+                                 chunk_rows, n)
+        rel = Relation(columns=rel.columns,
+                       mask=jnp.zeros(rel.capacity, dtype=jnp.bool_))
+        partials.append(chunk_fn({table: rel}))
     merged = ops.concat(partials) if len(partials) > 1 else partials[0]
 
     if group_node is not None:
@@ -193,7 +279,7 @@ def _pad(v, pad, fill=0):
 def numpy_chunk_provider(arrays: dict, valids: dict | None = None):
     """Granules from in-memory numpy columns (bench path)."""
 
-    def provider(table, chunk_rows):
+    def provider(table, chunk_rows, bounds=None):
         n = len(next(iter(arrays.values())))
         for s in range(0, n, chunk_rows):
             e = min(s + chunk_rows, n)
@@ -215,7 +301,7 @@ def segment_chunk_provider(tablet, snapshot: int):
     fusing memtable + SSTables, ob_multiple_scan_merge).
     """
 
-    def provider(table, chunk_rows):
+    def provider(table, chunk_rows, bounds=None):
         seen: set = set()
         key_cols = tablet.key_cols
 
@@ -260,7 +346,19 @@ def segment_chunk_provider(tablet, snapshot: int):
         for seg in segs:
             if seg.min_version > snapshot:
                 continue
-            arrays, valids = seg.decode()
+            chunk_mask = None
+            if bounds:
+                import numpy as _np
+
+                chunk_mask = _np.ones(seg.n_chunks, dtype=bool)
+                for col, (lo, hi) in bounds.items():
+                    if col in seg.columns:
+                        chunk_mask &= seg.prune_chunks(col, lo, hi)
+                if not chunk_mask.any():
+                    continue  # whole segment skipped by zone maps
+                if chunk_mask.all():
+                    chunk_mask = None
+            arrays, valids = seg.decode(chunk_mask=chunk_mask)
             if seg.max_version > snapshot and "__version__" in arrays:
                 vis = arrays["__version__"] <= snapshot
                 arrays = {k: x[vis] for k, x in arrays.items()}

@@ -57,15 +57,15 @@ class Segment:
     # ------------------------------------------------------------------
     @staticmethod
     def build(segment_id: int, level: int, arrays: dict, types: dict,
-              valids: dict | None = None, min_version=0, max_version=0
-              ) -> "Segment":
+              valids: dict | None = None, min_version=0, max_version=0,
+              chunk_rows: int = CHUNK_ROWS) -> "Segment":
         n = len(next(iter(arrays.values()))) if arrays else 0
         cols: dict[str, list[EncodedColumn]] = {}
         for name, arr in arrays.items():
             valid = (valids or {}).get(name)
             chunks = []
-            for s in range(0, max(n, 1), CHUNK_ROWS):
-                e = min(s + CHUNK_ROWS, n)
+            for s in range(0, max(n, 1), chunk_rows):
+                e = min(s + chunk_rows, n)
                 v = valid[s:e] if valid is not None else None
                 chunks.append(encode_column(np.asarray(arr[s:e]), v))
             cols[name] = chunks
