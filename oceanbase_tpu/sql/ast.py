@@ -115,6 +115,7 @@ class ColumnSpec:
     dtype: SqlType
     nullable: bool = True
     primary_key: bool = False
+    auto_increment: bool = False
 
 
 @dataclass

@@ -414,11 +414,14 @@ class Binder:
     def _bind_join(self, j: ast.JoinRef, qb: QueryBlock, scope: Scope):
         if j.kind in ("inner", "cross"):
             # inner joins melt into the join graph
+            n_before = len(qb.fragments)
             self._bind_table_expr(j.left, qb, scope)
+            n_mid = len(qb.fragments)
             self._bind_table_expr(j.right, qb, scope)
-            if j.on is not None:
-                on = self._expand_using(j.on, scope)
-                self._bind_where(on, qb, scope)
+            if isinstance(j.on, tuple) and j.on and j.on[0] == "using":
+                self._bind_using_edges(j.on[1], qb, n_before, n_mid)
+            elif j.on is not None:
+                self._bind_where(j.on, qb, scope)
             return
         if j.kind == "right":
             j = ast.JoinRef(j.right, j.left, "left", j.on)
@@ -427,8 +430,13 @@ class Binder:
         # side collapses to one fragment via the join-tree builder.
         lf = self._bind_side(j.left, scope)
         rf = self._bind_side(j.right, scope)
-        on = self._expand_using(j.on, scope)
-        eqs, lpreds, rpreds, residual = self._split_on(on, lf, rf, scope)
+        on = j.on
+        if isinstance(on, tuple) and on and on[0] == "using":
+            eqs = [(ir.col(self._col_in(lf, c)), ir.col(self._col_in(rf, c)))
+                   for c in on[1]]
+            lpreds = rpreds = residual = []
+        else:
+            eqs, lpreds, rpreds, residual = self._split_on(on, lf, rf, scope)
         for p in rpreds:
             rf = Fragment(pp.Filter(rf.plan, p), rf.cols,
                           max(1, rf.est_rows // 3), rf.unique_cols)
@@ -469,14 +477,31 @@ class Binder:
             unique |= f.unique_cols
         return Fragment(plan, cols, est, unique, colids=colids)
 
-    def _expand_using(self, on, scope):
-        if isinstance(on, tuple) and on and on[0] == "using":
-            conj = None
-            for c in on[1]:
-                p = ir.Cmp("=", ir.ColumnRef(c), ir.ColumnRef(c))
-                raise BindError("USING requires distinct qualifiers; use ON")
-            return conj
-        return on
+    @staticmethod
+    def _col_in(frag: Fragment, name: str) -> str:
+        cid = frag.cols.get(name)
+        if cid is None:
+            raise BindError(f"USING column {name!r} missing on one side")
+        return cid
+
+    def _bind_using_edges(self, cols, qb: QueryBlock, n_before: int,
+                          n_mid: int):
+        """USING (c1, ...): equality edges between the two just-bound
+        sides, resolved per side (the flat scope would see the shared
+        names as ambiguous)."""
+        left_frags = qb.fragments[n_before:n_mid]
+        right_frags = qb.fragments[n_mid:]
+        for c in cols:
+            li = next((i for i, f in enumerate(left_frags, n_before)
+                       if c in f.cols), None)
+            ri = next((i for i, f in enumerate(right_frags, n_mid)
+                       if c in f.cols), None)
+            if li is None or ri is None:
+                raise BindError(f"USING column {c!r} missing on one side")
+            qb.join_edges.append((
+                li, ri,
+                ir.col(qb.fragments[li].cols[c]),
+                ir.col(qb.fragments[ri].cols[c])))
 
     def _split_on(self, on, lf: Fragment, rf: Fragment, scope: Scope):
         """Split a bound ON condition into equi keys / side preds / residual."""

@@ -862,6 +862,7 @@ class Parser:
                 dtype = self.parse_type()
                 nullable = True
                 is_pk = False
+                auto_inc = False
                 while True:
                     if self.accept_kw("not"):
                         self.expect_kw("null")
@@ -871,9 +872,14 @@ class Parser:
                     elif self.accept_kw("primary"):
                         self.expect_kw("key")
                         is_pk = True
+                    elif self.peek().kind == "ident" and \
+                            self.peek().value == "auto_increment":
+                        self.next()
+                        auto_inc = True
                     else:
                         break
-                cols.append(ast.ColumnSpec(cname, dtype, nullable, is_pk))
+                cols.append(ast.ColumnSpec(cname, dtype, nullable, is_pk,
+                                           auto_inc))
                 if is_pk:
                     pk.append(cname)
             if not self.accept_op(","):

@@ -598,6 +598,17 @@ class Session:
         cols = [ColumnDef(c.name, c.dtype, c.nullable) for c in stmt.columns]
         tdef = TableDef(stmt.name, cols, primary_key=stmt.primary_key)
         self.catalog.create_table(tdef, if_not_exists=stmt.if_not_exists)
+        # AUTO_INCREMENT backs onto a hidden sequence (≙ table auto-inc
+        # service riding the sequence allocator)
+        for c in stmt.columns:
+            if getattr(c, "auto_increment", False) and \
+                    self.tenant is not None:
+                seq = f"__ai_{stmt.name}_{c.name}"
+                try:
+                    self.tenant.sequences.create(seq, start=1)
+                except ValueError:
+                    pass  # already exists (IF NOT EXISTS re-run)
+                tdef.ndv[f"__auto_increment_{c.name}"] = 1  # marker
         if self.db is not None:
             return _ok()  # the engine serves empty snapshots itself
         # seed an all-dead single-row relation (static shapes need cap >= 1)
@@ -683,6 +694,7 @@ class Session:
                     values[c] = _coerce_value(v, t, cdef.dtype)
                 for c in td.columns:
                     values.setdefault(c.name, None)
+                self._fill_auto_increment(td, values)
                 rows_values.append(values)
         else:
             sub = self._execute_select(stmt.select, params)
@@ -697,6 +709,7 @@ class Session:
                         values[c] = x.item() if hasattr(x, "item") else x
                 for c in td.columns:
                     values.setdefault(c.name, None)
+                self._fill_auto_increment(td, values)
                 rows_values.append(values)
         tablet = self._engine.tables[stmt.table].tablet
 
@@ -710,6 +723,15 @@ class Session:
         self.catalog.invalidate(stmt.table)
         self._maybe_freeze(stmt.table)
         return _ok(rowcount=len(rows_values))
+
+    def _fill_auto_increment(self, td, values: dict):
+        if self.tenant is None:
+            return
+        for c in td.columns:
+            if values.get(c.name) is None and \
+                    f"__auto_increment_{c.name}" in td.ndv:
+                values[c.name] = self.tenant.sequences.nextval(
+                    f"__ai_{td.name}_{c.name}")
 
     def _matching_rows(self, table: str, where, params, tx):
         """-> (rel, mask, tablet): relation at the statement tx's snapshot

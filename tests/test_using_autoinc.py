@@ -1,0 +1,41 @@
+"""USING join clause + AUTO_INCREMENT tests."""
+
+import pytest
+
+from oceanbase_tpu.server import Database
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(str(tmp_path / "db"))
+    yield d
+    d.close()
+
+
+def test_join_using(db):
+    s = db.session()
+    s.execute("create table a (id int primary key, av int)")
+    s.execute("create table b (id int, bv int)")
+    s.execute("insert into a values (1, 10), (2, 20), (3, 30)")
+    s.execute("insert into b values (1, 100), (1, 101), (3, 300)")
+    r = s.execute("select a.id, av, bv from a join b using (id) "
+                  "order by a.id, bv")
+    assert r.rows() == [(1, 10, 100), (1, 10, 101), (3, 30, 300)]
+    # left join using
+    r = s.execute("select a.id, bv from a left join b using (id) "
+                  "order by a.id, bv")
+    rows = r.rows()
+    assert (2, None) in rows and len(rows) == 4
+
+
+def test_auto_increment(db):
+    s = db.session()
+    s.execute("create table t (id int primary key auto_increment, "
+              "name varchar(10))")
+    s.execute("insert into t (name) values ('a'), ('b')")
+    s.execute("insert into t values (100, 'x')")   # explicit id wins
+    s.execute("insert into t (name) values ('c')")
+    rows = s.execute("select id, name from t order by id").rows()
+    ids = [r[0] for r in rows]
+    assert ids[:2] == [1, 2] and 100 in ids
+    assert len(set(ids)) == 4
