@@ -34,6 +34,9 @@ class TableDef:
     # optimizer stats (≙ src/share/stat basic table stats)
     row_count: int = 0
     ndv: dict[str, int] = field(default_factory=dict)
+    # range partitioning: (column, [upper-exclusive split points]) or None
+    partition: tuple | None = None
+    auto_increment_cols: list = field(default_factory=list)
 
     def column(self, name: str) -> ColumnDef:
         for c in self.columns:

@@ -79,6 +79,19 @@ class SequenceManager:
             self._next[name] += d.increment
             return v
 
+    def advance_past(self, name: str, value: int):
+        """Bump the counter beyond an explicitly supplied value (MySQL
+        AUTO_INCREMENT semantics: explicit inserts advance the counter)."""
+        with self._lock:
+            d = self._defs.get(name)
+            if d is None or d.increment <= 0:
+                return
+            if self._next[name] <= value:
+                self._next[name] = value + d.increment
+                if self._limit[name] < self._next[name]:
+                    self._limit[name] = self._next[name]
+                self._persist(name, self._limit[name])
+
     def _persist(self, name: str, hwm: int):
         if self.engine is None:
             return

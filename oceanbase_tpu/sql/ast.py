@@ -124,6 +124,8 @@ class CreateTableStmt:
     columns: list  # list[ColumnSpec]
     primary_key: list = field(default_factory=list)
     if_not_exists: bool = False
+    # PARTITION BY RANGE(col): (col, [upper-exclusive bounds]) or None
+    partition: tuple | None = None
 
 
 @dataclass

@@ -885,7 +885,45 @@ class Parser:
             if not self.accept_op(","):
                 break
         self.expect_op(")")
-        return ast.CreateTableStmt(name, cols, pk, if_not_exists)
+        partition = None
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            if self.expect_ident() != "range":
+                raise ParseError("only PARTITION BY RANGE is supported")
+            self.expect_op("(")
+            pcol = self.expect_ident()
+            self.expect_op(")")
+            self.expect_op("(")
+            bounds = []
+            saw_maxvalue = False
+            while True:
+                if saw_maxvalue:
+                    raise ParseError(
+                        "MAXVALUE partition must be last")
+                self.expect_kw("partition")
+                self.expect_ident()  # partition name (unused)
+                self.expect_kw("values")
+                if self.expect_ident() != "less":
+                    raise ParseError("expected VALUES LESS THAN")
+                if self.expect_ident() != "than":
+                    raise ParseError("expected VALUES LESS THAN")
+                if self.peek().kind == "ident" and \
+                        self.peek().value == "maxvalue":
+                    self.next()
+                    saw_maxvalue = True
+                else:
+                    self.expect_op("(")
+                    b = self._signed_int()
+                    if bounds and b <= bounds[-1]:
+                        raise ParseError(
+                            "partition bounds must be increasing")
+                    bounds.append(b)
+                    self.expect_op(")")
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            partition = (pcol, bounds)
+        return ast.CreateTableStmt(name, cols, pk, if_not_exists, partition)
 
     def parse_drop(self):
         self.expect_kw("drop")

@@ -596,19 +596,22 @@ class Session:
     # ------------------------------------------------------------------
     def _create_table(self, stmt: ast.CreateTableStmt) -> Result:
         cols = [ColumnDef(c.name, c.dtype, c.nullable) for c in stmt.columns]
-        tdef = TableDef(stmt.name, cols, primary_key=stmt.primary_key)
+        auto_cols = [c.name for c in stmt.columns
+                     if getattr(c, "auto_increment", False)]
+        tdef = TableDef(stmt.name, cols, primary_key=stmt.primary_key,
+                        partition=getattr(stmt, "partition", None),
+                        auto_increment_cols=auto_cols)
         self.catalog.create_table(tdef, if_not_exists=stmt.if_not_exists)
-        # AUTO_INCREMENT backs onto a hidden sequence (≙ table auto-inc
-        # service riding the sequence allocator)
-        for c in stmt.columns:
-            if getattr(c, "auto_increment", False) and \
-                    self.tenant is not None:
-                seq = f"__ai_{stmt.name}_{c.name}"
+        # AUTO_INCREMENT backs onto a hidden persisted sequence (≙ table
+        # auto-inc service riding the sequence allocator); the column list
+        # itself persists with the table definition
+        if self.tenant is not None:
+            for cname in auto_cols:
+                seq = f"__ai_{stmt.name}_{cname}"
                 try:
                     self.tenant.sequences.create(seq, start=1)
                 except ValueError:
                     pass  # already exists (IF NOT EXISTS re-run)
-                tdef.ndv[f"__auto_increment_{c.name}"] = 1  # marker
         if self.db is not None:
             return _ok()  # the engine serves empty snapshots itself
         # seed an all-dead single-row relation (static shapes need cap >= 1)
@@ -727,11 +730,19 @@ class Session:
     def _fill_auto_increment(self, td, values: dict):
         if self.tenant is None:
             return
-        for c in td.columns:
-            if values.get(c.name) is None and \
-                    f"__auto_increment_{c.name}" in td.ndv:
-                values[c.name] = self.tenant.sequences.nextval(
-                    f"__ai_{td.name}_{c.name}")
+        for cname in getattr(td, "auto_increment_cols", []):
+            seq = f"__ai_{td.name}_{cname}"
+            if seq not in self.tenant.sequences._defs:
+                self.tenant.sequences.create(seq, start=1)
+            if values.get(cname) is None:
+                values[cname] = self.tenant.sequences.nextval(seq)
+            else:
+                # explicit value advances the counter (MySQL semantics)
+                try:
+                    self.tenant.sequences.advance_past(seq,
+                                                       int(values[cname]))
+                except (TypeError, ValueError):
+                    pass
 
     def _matching_rows(self, table: str, where, params, tx):
         """-> (rel, mask, tablet): relation at the statement tx's snapshot
@@ -789,6 +800,11 @@ class Session:
             new_host[cname] = (vals, vv)
 
         key_changed = any(c in tablet.key_cols for c, _ in stmt.assignments)
+        # an update that moves a row across range partitions must also be
+        # delete+insert (the versions live in different tablets)
+        part_col = getattr(tablet, "part_col", None)
+        part_changed = part_col is not None and \
+            any(c == part_col for c, _ in stmt.assignments)
 
         def op(tx):
             for i in range(n_upd):
@@ -807,17 +823,21 @@ class Session:
                                      else (x.item() if hasattr(x, "item")
                                            else x))
                 new_key = tuple(values[k] for k in tablet.key_cols)
-                if key_changed:
+                moved = False
+                if part_changed:
+                    moved = tablet.route_partition_index(old_values) != \
+                        tablet.route_partition_index(values)
+                if key_changed or moved:
                     old_key = tuple(old_values[k] for k in tablet.key_cols)
-                    if old_key != new_key:
-                        # PK update = delete old row + insert new row
+                    if old_key != new_key or moved:
+                        # PK/partition move = delete old row + insert new
                         self._txsvc.write(tx, stmt.table, tablet, old_key,
-                                         "delete", old_values)
+                                          "delete", old_values)
                         self._txsvc.write(tx, stmt.table, tablet, new_key,
-                                         "insert", values)
+                                          "insert", values)
                         continue
                 self._txsvc.write(tx, stmt.table, tablet, new_key, "update",
-                                 values)
+                                  values)
 
         self._run_in_tx(op, tx_hint=tx_hint)
         self.catalog.invalidate(stmt.table)

@@ -80,8 +80,12 @@ class StorageEngine:
                                  c.dtype.precision, c.dtype.scale,
                                  c.nullable] for c in ts.tdef.columns],
                     "primary_key": ts.tdef.primary_key,
-                    "segments": [[s.segment_id, s.level] for s in
-                                 ts.tablet.segments],
+                    "partition": (list(ts.tdef.partition)
+                                  if ts.tdef.partition else None),
+                    "auto_increment": list(ts.tdef.auto_increment_cols),
+                    "segments": [[s.segment_id, s.level, part]
+                                 for s, part in
+                                 ts.tablet.segment_locations()],
                 }
             tmp = self._manifest_path() + ".tmp"
             with open(tmp, "w") as f:
@@ -103,13 +107,19 @@ class StorageEngine:
             for name, t in m["tables"].items():
                 cols = [ColumnDef(n, SqlType(TypeKind(k), p, s), nl)
                         for n, k, p, s, nl in t["columns"]]
-                tdef = TableDef(name, cols, primary_key=t["primary_key"])
+                part = t.get("partition")
+                tdef = TableDef(name, cols, primary_key=t["primary_key"],
+                                partition=tuple(part) if part else None,
+                                auto_increment_cols=t.get("auto_increment",
+                                                          []))
                 self._install_table(tdef, log=False)
                 ts = self.tables[name]
-                for seg_id, level in t["segments"]:
+                for entry in t["segments"]:
+                    seg_id, level = entry[0], entry[1]
+                    part_idx = entry[2] if len(entry) > 2 else None
                     path = self._segment_file(name, seg_id)
                     if os.path.exists(path):
-                        ts.tablet.segments.append(Segment.load(path))
+                        ts.tablet.add_segment(Segment.load(path), part_idx)
                 ts.tdef.row_count = ts.tablet.row_count_estimate()
         # replay metadata ops logged after the checkpoint
         if os.path.exists(self._slog_path()):
@@ -123,8 +133,11 @@ class StorageEngine:
         if kind == "create_table":
             cols = [ColumnDef(n, SqlType(TypeKind(k), p, s), nl)
                     for n, k, p, s, nl in op["columns"]]
+            part = op.get("partition")
             self._install_table(
-                TableDef(op["name"], cols, primary_key=op["primary_key"]),
+                TableDef(op["name"], cols, primary_key=op["primary_key"],
+                         partition=tuple(part) if part else None,
+                         auto_increment_cols=op.get("auto_increment", [])),
                 log=False)
         elif kind == "drop_table":
             self.tables.pop(op["name"], None)
@@ -133,16 +146,16 @@ class StorageEngine:
             if ts is not None:
                 path = self._segment_file(op["table"], op["segment_id"])
                 if os.path.exists(path):
-                    ts.tablet.segments.append(Segment.load(path))
+                    ts.tablet.add_segment(Segment.load(path),
+                                          op.get("part"))
         elif kind == "replace_segments":
             ts = self.tables.get(op["table"])
             if ts is not None:
-                keep = [s for s in ts.tablet.segments
-                        if s.segment_id not in set(op["removed"])]
+                ts.tablet.remove_segments(op["removed"])
                 path = self._segment_file(op["table"], op["segment_id"])
                 if os.path.exists(path):
-                    keep.append(Segment.load(path))
-                ts.tablet.segments = keep
+                    ts.tablet.add_segment(Segment.load(path),
+                                          op.get("part"))
 
     def _segment_file(self, table: str, seg_id: int) -> str:
         return os.path.join(self.root, "segments", f"{table}_{seg_id}.npz")
@@ -160,7 +173,15 @@ class StorageEngine:
             columns.append("__rowid__")
             types["__rowid__"] = SqlType.int_()
             key_cols = ["__rowid__"]
-        tablet = Tablet(len(self.tables) + 1, columns, types, key_cols)
+        if tdef.partition is not None:
+            from oceanbase_tpu.storage.partition import PartitionedTablet
+
+            part_col, bounds = tdef.partition
+            tablet = PartitionedTablet(len(self.tables) + 1, columns,
+                                       types, key_cols, part_col,
+                                       list(bounds))
+        else:
+            tablet = Tablet(len(self.tables) + 1, columns, types, key_cols)
         self.tables[tdef.name] = TableStore(tdef, tablet)
         if log:
             self._log_meta({
@@ -169,6 +190,9 @@ class StorageEngine:
                              c.dtype.scale, c.nullable]
                             for c in tdef.columns],
                 "primary_key": tdef.primary_key,
+                "partition": (list(tdef.partition)
+                              if tdef.partition else None),
+                "auto_increment": list(tdef.auto_increment_cols),
             })
 
     def create_table(self, tdef: TableDef):
@@ -194,21 +218,42 @@ class StorageEngine:
                 arrays = dict(arrays)
                 arrays["__rowid__"] = np.arange(base, base + n,
                                                 dtype=np.int64)
-            seg = Segment.build(
-                next(ts.tablet._next_seg), 2, arrays,
-                ts.tablet.types, valids, min_version=version,
-                max_version=version)
-            ts.tablet.segments.append(seg)
-            ts.tablet.data_version += 1
+            from oceanbase_tpu.storage.partition import PartitionedTablet
+
+            if isinstance(ts.tablet, PartitionedTablet):
+                parts = ts.tablet.split_arrays_by_partition(arrays)
+                targets = [(i, pa,
+                            {k: v[sel] for k, v in (valids or {}).items()
+                             if v is not None})
+                           for i, pa, sel in parts]
+            else:
+                targets = [(None, arrays, valids or {})]
+            for part_idx, pa, pv in targets:
+                tab = (ts.tablet.partitions[part_idx]
+                       if part_idx is not None else ts.tablet)
+                seg = Segment.build(
+                    next(tab._next_seg), 2, pa, ts.tablet.types,
+                    pv or None, min_version=version, max_version=version)
+                ts.tablet.add_segment(seg, part_idx)
+                if self.root is not None:
+                    seg.save(self._segment_file(name, seg.segment_id))
+                    self._log_meta({"op": "add_segment", "table": name,
+                                    "segment_id": seg.segment_id,
+                                    "part": part_idx})
             ts.tdef.row_count = ts.tablet.row_count_estimate()
-            if self.root is not None:
-                seg.save(self._segment_file(name, seg.segment_id))
-                self._log_meta({"op": "add_segment", "table": name,
-                                "segment_id": seg.segment_id})
 
     # ------------------------------------------------------------------
     # compaction driving (≙ tenant tablet scheduler ticks)
     # ------------------------------------------------------------------
+    @staticmethod
+    def _new_segs(res):
+        """Normalize compact results: Segment | [(part, Segment)] | None."""
+        if res is None:
+            return []
+        if isinstance(res, Segment):
+            return [(None, res)]
+        return list(res)
+
     def freeze_and_flush(self, name: str, snapshot: int):
         from oceanbase_tpu.server.errsim import ERRSIM
 
@@ -216,37 +261,41 @@ class StorageEngine:
         with self._lock:
             ts = self.tables[name]
             ts.tablet.freeze()
-            seg = ts.tablet.mini_compact(snapshot)
-            if seg is not None and self.root is not None:
-                seg.save(self._segment_file(name, seg.segment_id))
-                self._log_meta({"op": "add_segment", "table": name,
-                                "segment_id": seg.segment_id})
-            return seg
+            segs = self._new_segs(ts.tablet.mini_compact(snapshot))
+            if self.root is not None:
+                for part, seg in segs:
+                    seg.save(self._segment_file(name, seg.segment_id))
+                    self._log_meta({"op": "add_segment", "table": name,
+                                    "segment_id": seg.segment_id,
+                                    "part": part})
+            return segs[0][1] if segs else None
 
-    def minor_compact(self, name: str):
+    def _compact(self, name: str, level_filter, method: str):
         with self._lock:
             ts = self.tables[name]
             old_ids = [s.segment_id for s in ts.tablet.segments
-                       if s.level == 0]
-            seg = ts.tablet.minor_compact()
-            if seg is not None and self.root is not None:
-                seg.save(self._segment_file(name, seg.segment_id))
-                self._log_meta({"op": "replace_segments", "table": name,
-                                "segment_id": seg.segment_id,
-                                "removed": old_ids})
-            return seg
+                       if level_filter(s.level)]
+            segs = self._new_segs(getattr(ts.tablet, method)())
+            if segs and self.root is not None:
+                # only segments ACTUALLY gone may be logged as removed — a
+                # partition that declined to compact keeps its segments
+                after = {s.segment_id for s in ts.tablet.segments}
+                removed = [i for i in old_ids if i not in after]
+                first = True
+                for part, seg in segs:
+                    seg.save(self._segment_file(name, seg.segment_id))
+                    self._log_meta({"op": "replace_segments", "table": name,
+                                    "segment_id": seg.segment_id,
+                                    "part": part,
+                                    "removed": removed if first else []})
+                    first = False
+            return segs[0][1] if segs else None
+
+    def minor_compact(self, name: str):
+        return self._compact(name, lambda lv: lv == 0, "minor_compact")
 
     def major_compact(self, name: str):
-        with self._lock:
-            ts = self.tables[name]
-            old_ids = [s.segment_id for s in ts.tablet.segments]
-            seg = ts.tablet.major_compact()
-            if seg is not None and self.root is not None:
-                seg.save(self._segment_file(name, seg.segment_id))
-                self._log_meta({"op": "replace_segments", "table": name,
-                                "segment_id": seg.segment_id,
-                                "removed": old_ids})
-            return seg
+        return self._compact(name, lambda lv: True, "major_compact")
 
 
 class StorageCatalog(Catalog):

@@ -1,0 +1,192 @@
+"""Range-partitioned tables: multiple tablets per table.
+
+Reference analog: partitioned tables mapping to multiple tablets hosted
+by log streams (src/storage/tablet + the partition routing the DAS layer
+performs).  A PartitionedTablet keeps the single-tablet interface the
+rest of the engine uses (write/commit/abort/freeze/compact/snapshot) and
+routes internally:
+
+- writes route by the partition key's range (≙ PKEY slice routing)
+- snapshot reads concatenate per-partition arrays (scans parallelize
+  naturally — each partition is an independent granule source)
+- freeze/compaction iterate partitions (≙ per-tablet merge DAGs)
+
+Bounds are upper-exclusive split points: bounds [10, 20] makes partitions
+(-inf,10), [10,20), [20,+inf).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+import numpy as np
+
+from oceanbase_tpu.storage.tablet import Tablet
+
+
+class PartitionedTablet:
+    def __init__(self, tablet_id: int, columns, types, key_cols,
+                 part_col: str, bounds: list):
+        if part_col not in columns:
+            raise ValueError(
+                f"partition column {part_col!r} is not a table column")
+        if any(bounds[i] >= bounds[i + 1] for i in range(len(bounds) - 1)):
+            raise ValueError("partition bounds must be strictly increasing")
+        self.part_col = part_col
+        self.bounds = list(bounds)
+        self.columns = list(columns)
+        self.types = dict(types)
+        self.key_cols = list(key_cols)
+        self.partitions = [
+            Tablet(tablet_id * 1000 + i, columns, types, key_cols)
+            for i in range(len(bounds) + 1)
+        ]
+        # one segment-id space across partitions (filenames stay unique)
+        import itertools
+
+        shared = itertools.count(1)
+        for p in self.partitions:
+            p._next_seg = shared
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+    @property
+    def data_version(self) -> int:
+        return sum(p.data_version for p in self.partitions)
+
+    @property
+    def active(self):  # pragma: no cover - debug convenience
+        return self.partitions[0].active
+
+    @property
+    def frozen(self):
+        out = []
+        for p in self.partitions:
+            out.extend(p.frozen)
+        return out
+
+    @property
+    def segments(self):
+        out = []
+        for p in self.partitions:
+            out.extend(p.segments)
+        return out
+
+    def _route(self, values: dict) -> Tablet:
+        v = values.get(self.part_col)
+        if v is None:
+            return self.partitions[0]  # NULLs live in the first partition
+        return self.partitions[bisect.bisect_right(self.bounds, v)]
+
+    def _route_key(self, key: tuple) -> Tablet | None:
+        """Route by key when the partition column is part of the key."""
+        if self.part_col in self.key_cols:
+            v = key[self.key_cols.index(self.part_col)]
+            return self.partitions[bisect.bisect_right(self.bounds, v)]
+        return None
+
+    # ------------------------------------------------------------------
+    def make_key(self, values: dict) -> tuple:
+        return self._route(values).make_key(values)
+
+    def next_rowid(self, n: int) -> int:
+        return self.partitions[0].next_rowid(n)
+
+    def write(self, key: tuple, op: str, values: dict, tx_id: int,
+              stmt_seq: int = 0, snapshot=None):
+        t = self._route_key(key) or self._route(values)
+        return t.write(key, op, values, tx_id, stmt_seq, snapshot)
+
+    def commit(self, tx_id: int, commit_version: int, keys):
+        for p in self.partitions:
+            p.commit(tx_id, commit_version, keys)
+
+    def abort(self, tx_id: int, keys, min_stmt_seq: int = 0):
+        for p in self.partitions:
+            p.abort(tx_id, keys, min_stmt_seq)
+
+    # ------------------------------------------------------------------
+    def freeze(self):
+        for p in self.partitions:
+            p.freeze()
+
+    def mini_compact(self, snapshot: int):
+        """-> list[(part_idx, Segment)] of newly produced segments."""
+        out = []
+        for i, p in enumerate(self.partitions):
+            s = p.mini_compact(snapshot)
+            if s is not None:
+                out.append((i, s))
+        return out or None
+
+    def minor_compact(self):
+        out = []
+        for i, p in enumerate(self.partitions):
+            s = p.minor_compact()
+            if s is not None:
+                out.append((i, s))
+        return out or None
+
+    def major_compact(self):
+        out = []
+        for i, p in enumerate(self.partitions):
+            s = p.major_compact()
+            if s is not None:
+                out.append((i, s))
+        return out or None
+
+    # ------------------------------------------------------------------
+    def snapshot_arrays(self, snapshot: int, tx_id: int = 0):
+        parts = [p.snapshot_arrays(snapshot, tx_id)
+                 for p in self.partitions]
+        arrays: dict = {}
+        valids: dict = {}
+        for c in self.columns:
+            chunks = [a[c] for a, _v in parts if c in a]
+            if any(x.dtype == object for x in chunks):
+                chunks = [x.astype(object) for x in chunks]
+            arrays[c] = np.concatenate(chunks) if chunks else \
+                np.zeros(0, dtype=self.types[c].np_dtype)
+            vs = [v.get(c) for _a, v in parts]
+            if any(x is not None for x in vs):
+                valids[c] = np.concatenate(
+                    [x if x is not None
+                     else np.ones(len(a[c]), dtype=bool)
+                     for (a, v), x in zip(parts, vs)])
+            else:
+                valids[c] = None
+        return arrays, valids
+
+    def row_count_estimate(self) -> int:
+        return sum(p.row_count_estimate() for p in self.partitions)
+
+    # -- segment management hooks ----------------------------------------
+    def add_segment(self, seg, part_idx=None):
+        self.partitions[part_idx or 0].add_segment(seg)
+
+    def remove_segments(self, ids):
+        for p in self.partitions:
+            p.remove_segments(ids)
+
+    def segment_locations(self):
+        out = []
+        for i, p in enumerate(self.partitions):
+            out.extend((s, i) for s in p.segments)
+        return out
+
+    def split_arrays_by_partition(self, arrays: dict):
+        """Bulk-load routing: -> [(part_idx, {col -> rows})] per range."""
+        col = arrays[self.part_col]
+        idx = np.searchsorted(np.asarray(self.bounds), col, side="right")
+        out = []
+        for i in range(len(self.partitions)):
+            sel = idx == i
+            if sel.any():
+                out.append((i, {k: v[sel] for k, v in arrays.items()}, sel))
+        return out
+
+    def route_partition_index(self, values: dict) -> int:
+        """Which partition a row with these values lives in (DML uses it
+        to detect partition-moving updates)."""
+        return self.partitions.index(self._route(values))

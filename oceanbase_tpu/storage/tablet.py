@@ -240,6 +240,21 @@ class Tablet:
         return sum(s.n_rows for s in self.segments) + len(self.active) + \
             sum(len(m) for m in self.frozen)
 
+    # -- segment management hooks (shared with PartitionedTablet) --------
+    def add_segment(self, seg, part_idx=None):
+        self.segments.append(seg)
+        self.data_version += 1
+
+    def remove_segments(self, ids):
+        ids = set(ids)
+        self.segments = [s for s in self.segments
+                         if s.segment_id not in ids]
+        self.data_version += 1
+
+    def segment_locations(self):
+        """-> [(Segment, partition_idx|None)] for manifest checkpoints."""
+        return [(s, None) for s in self.segments]
+
 
 def _rows_to_arrays(rows: dict, columns, types):
     n = len(rows)
