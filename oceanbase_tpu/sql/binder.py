@@ -107,6 +107,7 @@ class Fragment:
     est_rows: int
     unique_cols: frozenset = frozenset()  # colids known unique (PK)
     colids: frozenset = frozenset()       # every colid this subtree produces
+    ndv: dict = field(default_factory=dict)  # colid -> distinct-value est
 
     def __post_init__(self):
         if not self.colids:
@@ -399,16 +400,20 @@ class Binder:
         rename = {}
         cols = {}
         unique = []
+        ndv = {}
         for c in tdef.columns:
             cid = fresh(f"{alias}_{c.name}")
             rename[c.name] = cid
             scope.add(c.name, cid, alias=alias)
             cols[c.name] = cid
+            if c.name in tdef.ndv:
+                ndv[cid] = tdef.ndv[c.name]
         if len(tdef.primary_key) == 1:
             unique.append(rename[tdef.primary_key[0]])
+            ndv[rename[tdef.primary_key[0]]] = max(tdef.row_count, 1)
         qb.fragments.append(Fragment(
             pp.TableScan(name, rename=rename),
-            cols, max(tdef.row_count, 1), frozenset(unique),
+            cols, max(tdef.row_count, 1), frozenset(unique), ndv=ndv,
         ))
 
     def _bind_join(self, j: ast.JoinRef, qb: QueryBlock, scope: Scope):

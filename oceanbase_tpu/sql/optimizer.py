@@ -50,6 +50,7 @@ def build_join_tree(qb, catalog, capacity_factor: float = 1.5):
     remaining.discard(start)
     plan = frags[start].plan
     est = frags[start].est_rows
+    tree_ndv: dict = dict(frags[start].ndv)
 
     def edge_keys(i):
         keys = []
@@ -67,18 +68,32 @@ def build_join_tree(qb, catalog, capacity_factor: float = 1.5):
         f = frags[nxt]
         lkeys = [k[0] for k in keys]
         rkeys = [k[1] for k in keys]
-        # cardinality: PK join keeps probe side, otherwise expand
+        # cardinality: PK join keeps probe side; otherwise the classic
+        # |L ⋈ R| ≈ |L|·|R| / max(ndv_L(k), ndv_R(k)) with NDV from
+        # ANALYZE stats (≙ ObOptEstCost join selectivity)
         rkey_cols = {k.name for k in rkeys if isinstance(k, ir.ColumnRef)}
         if keys and rkey_cols & set(f.unique_cols):
             out_est = est
         elif not keys:
             out_est = est * max(f.est_rows, 1)
         else:
-            out_est = max(est * 2, f.est_rows)
+            ndvs = []
+            for lk, rk in keys:
+                if isinstance(lk, ir.ColumnRef) and lk.name in tree_ndv:
+                    ndvs.append(tree_ndv[lk.name])
+                if isinstance(rk, ir.ColumnRef) and rk.name in f.ndv:
+                    ndvs.append(f.ndv[rk.name])
+            if ndvs:
+                out_est = max(1, est * max(f.est_rows, 1) // max(ndvs))
+                # keep headroom: estimates are approximate
+                out_est = max(out_est, est // 2, f.est_rows // 2)
+            else:
+                out_est = max(est * 2, f.est_rows)
         cap = _pow2(int(out_est * capacity_factor) + 16)
         plan = pp.HashJoin(plan, f.plan, lkeys, rkeys, how="inner",
                            out_capacity=cap)
         est = max(1, out_est)
+        tree_ndv.update(f.ndv)
         joined.add(nxt)
         remaining.discard(nxt)
 
