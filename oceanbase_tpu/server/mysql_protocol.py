@@ -50,11 +50,24 @@ def lenenc_str(s: bytes) -> bytes:
     return lenenc_int(len(s)) + s
 
 
+def _read_lenenc(buf: bytes, pos: int):
+    c = buf[pos]
+    if c < 251:
+        return c, pos + 1
+    if c == 0xFC:
+        return struct.unpack_from("<H", buf, pos + 1)[0], pos + 3
+    if c == 0xFD:
+        return struct.unpack("<I", buf[pos + 1:pos + 4] + b"\x00")[0], pos + 4
+    return struct.unpack_from("<Q", buf, pos + 1)[0], pos + 9
+
+
 class _Conn:
     def __init__(self, sock: socket.socket, session):
         self.sock = sock
         self.session = session
         self.seq = 0
+        self._stmts: dict[int, tuple] = {}  # stmt_id -> (sql, n_params)
+        self._next_stmt = 1
 
     # ---- packet framing ------------------------------------------------
     def send(self, payload: bytes):
@@ -187,7 +200,149 @@ class _Conn:
             if cmd == 0x03:               # COM_QUERY
                 self._handle_query(arg.decode(errors="replace"))
                 continue
+            if cmd == 0x16:               # COM_STMT_PREPARE
+                self._stmt_prepare(arg.decode(errors="replace"))
+                continue
+            if cmd == 0x17:               # COM_STMT_EXECUTE
+                self._stmt_execute(arg)
+                continue
+            if cmd == 0x19:               # COM_STMT_CLOSE (no response)
+                if len(arg) >= 4:
+                    self._stmts.pop(struct.unpack_from("<I", arg)[0], None)
+                continue
+            if cmd == 0x1A:               # COM_STMT_RESET
+                self.send_ok()
+                continue
             self.send_err(1047, f"unsupported command {cmd:#x}")
+
+    # ---- prepared statements (binary protocol) --------------------------
+    def _stmt_prepare(self, sql: str):
+        """COM_STMT_PREPARE: parse once, report parameter count
+        (≙ the PS cache keyed per session)."""
+        try:
+            from oceanbase_tpu.sql.parser import Parser
+
+            p = Parser(sql)
+            p.parse()
+            n_params = p.n_params
+        except Exception as e:  # noqa: BLE001 — protocol boundary
+            self.send_err(1064, f"{type(e).__name__}: {e}")
+            return
+        stmt_id = self._next_stmt
+        self._next_stmt += 1
+        self._stmts[stmt_id] = (sql, n_params)
+        # PREPARE-OK: stmt id, 0 result columns (computed at execute),
+        # n params, warnings
+        self.send(b"\x00" + struct.pack("<IHHBH", stmt_id, 0, n_params,
+                                        0, 0))
+        for _ in range(n_params):
+            payload = (lenenc_str(b"def") + lenenc_str(b"") * 3 +
+                       lenenc_str(b"?") + lenenc_str(b"") +
+                       b"\x0c" + struct.pack("<H", 0x21) +
+                       struct.pack("<I", 255) + bytes([T_VAR_STRING]) +
+                       struct.pack("<H", 0) + b"\x00\x00\x00")
+            self.send(payload)
+        if n_params:
+            self.send_eof()
+
+    def _stmt_execute(self, arg: bytes):
+        if len(arg) < 9:
+            self.send_err(1064, "malformed COM_STMT_EXECUTE")
+            return
+        stmt_id = struct.unpack_from("<I", arg)[0]
+        ent = self._stmts.get(stmt_id)
+        if ent is None:
+            self.send_err(1243, f"unknown prepared statement {stmt_id}")
+            return
+        sql, n_params = ent
+        pos = 9  # id(4) + flags(1) + iteration_count(4)
+        params: list = []
+        if n_params:
+            nb = (n_params + 7) // 8
+            null_bitmap = arg[pos:pos + nb]
+            pos += nb
+            new_params_bound = arg[pos]
+            pos += 1
+            types = []
+            if new_params_bound:
+                for _ in range(n_params):
+                    types.append(struct.unpack_from("<H", arg, pos)[0])
+                    pos += 2
+                self._stmts[stmt_id] = (sql, n_params)
+                self._stmt_types = types
+            else:
+                types = getattr(self, "_stmt_types", [T_VAR_STRING] *
+                                n_params)
+            for i in range(n_params):
+                if null_bitmap[i // 8] & (1 << (i % 8)):
+                    params.append(None)
+                    continue
+                t = types[i] & 0xFF
+                v, pos = self._read_binary_value(arg, pos, t)
+                params.append(v)
+        try:
+            result = self.session.execute(sql, params=params)
+        except Exception as e:  # noqa: BLE001 — protocol boundary
+            self.send_err(1064, f"{type(e).__name__}: {e}")
+            return
+        if result.names:
+            self._send_binary_resultset(result)
+        else:
+            self.send_ok(affected=result.rowcount)
+
+    @staticmethod
+    def _read_binary_value(buf: bytes, pos: int, mtype: int):
+        if mtype in (1,):          # TINY
+            return struct.unpack_from("<b", buf, pos)[0], pos + 1
+        if mtype in (2,):          # SHORT
+            return struct.unpack_from("<h", buf, pos)[0], pos + 2
+        if mtype in (3, 9):        # LONG / INT24
+            return struct.unpack_from("<i", buf, pos)[0], pos + 4
+        if mtype == T_LONGLONG:
+            return struct.unpack_from("<q", buf, pos)[0], pos + 8
+        if mtype == 4:             # FLOAT
+            return struct.unpack_from("<f", buf, pos)[0], pos + 4
+        if mtype == T_DOUBLE:
+            return struct.unpack_from("<d", buf, pos)[0], pos + 8
+        # everything else ships as length-encoded string
+        ln, pos = _read_lenenc(buf, pos)
+        raw = buf[pos:pos + ln]
+        return raw.decode(errors="replace"), pos + ln
+
+    def _send_binary_resultset(self, result):
+        from oceanbase_tpu.datatypes import TypeKind
+
+        names = result.names
+        self.send(lenenc_int(len(names)))
+        mtypes = []
+        for name in names:
+            t = result.dtypes.get(name)
+            mtype, length, decimals = self._coltype(t)
+            mtypes.append((mtype, t))
+            payload = (lenenc_str(b"def") + lenenc_str(b"") * 3 +
+                       lenenc_str(name.encode()) + lenenc_str(name.encode()) +
+                       b"\x0c" + struct.pack("<H", 0x21) +
+                       struct.pack("<I", length) + bytes([mtype]) +
+                       struct.pack("<H", 0) + bytes([decimals]) + b"\x00\x00")
+            self.send(payload)
+        self.send_eof()
+        for row in result.rows():
+            nb = (len(row) + 7 + 2) // 8
+            bitmap = bytearray(nb)
+            body = b""
+            for i, (v, (mtype, t)) in enumerate(zip(row, mtypes)):
+                if v is None:
+                    bit = i + 2  # binary-row null bitmap offset is 2
+                    bitmap[bit // 8] |= 1 << (bit % 8)
+                    continue
+                if mtype == T_LONGLONG:
+                    body += struct.pack("<q", int(v))
+                elif mtype == T_DOUBLE:
+                    body += struct.pack("<d", float(v))
+                else:  # decimals, dates, strings ship as lenenc text
+                    body += lenenc_str(str(v).encode())
+            self.send(b"\x00" + bytes(bitmap) + body)
+        self.send_eof()
 
     def _handle_query(self, sql: str):
         try:

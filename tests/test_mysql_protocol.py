@@ -138,6 +138,63 @@ def test_wire_protocol_end_to_end(server):
     c.close()
 
 
+def test_prepared_statements_binary_protocol(server):
+    c = MiniClient(server.host, server.port)
+    c.query("create table p (k int primary key, v decimal(10,2))")
+    c.query("insert into p values (1, 1.50), (2, 2.25), (3, 3.75)")
+
+    # COM_STMT_PREPARE
+    c.seq = 0
+    c._send(b"\x16" + b"select k, v from p where k >= ? order by k")
+    ok = c._read_packet()
+    assert ok[0] == 0x00
+    stmt_id, ncols, nparams = struct.unpack_from("<IHH", ok, 1)
+    assert nparams == 1
+    for _ in range(nparams):
+        c._read_packet()       # param definition
+    if nparams:
+        assert c._read_packet()[0] == 0xFE
+
+    # COM_STMT_EXECUTE with one LONGLONG param = 2
+    c.seq = 0
+    payload = (b"\x17" + struct.pack("<IBI", stmt_id, 0, 1) +
+               b"\x00" +                    # null bitmap
+               b"\x01" +                    # new params bound
+               struct.pack("<H", 8) +       # type LONGLONG
+               struct.pack("<q", 2))
+    c._send(payload)
+    first = c._read_packet()
+    ncols, _ = c._lenenc(first, 0)
+    assert ncols == 2
+    for _ in range(ncols):
+        c._read_packet()
+    assert c._read_packet()[0] == 0xFE
+    rows = []
+    while True:
+        pkt = c._read_packet()
+        if pkt[0] == 0xFE and len(pkt) < 9:
+            break
+        assert pkt[0] == 0x00  # binary row header
+        pos = 1 + (ncols + 2 + 7) // 8
+        k = struct.unpack_from("<q", pkt, pos)[0]
+        pos += 8
+        ln, pos = c._lenenc(pkt, pos)
+        v = pkt[pos:pos + ln].decode()
+        rows.append((k, v))
+    assert rows == [(2, "2.25"), (3, "3.75")]
+
+    # COM_STMT_CLOSE then re-execute -> clean error
+    c.seq = 0
+    c._send(b"\x19" + struct.pack("<I", stmt_id))
+    c.seq = 0
+    c._send(b"\x17" + struct.pack("<IBI", stmt_id, 0, 1) + b"\x00\x01" +
+            struct.pack("<H", 8) + struct.pack("<q", 1))
+    err = c._read_packet()
+    assert err[0] == 0xFF
+    assert c.ping()
+    c.close()
+
+
 def test_wire_two_concurrent_sessions(server):
     c1 = MiniClient(server.host, server.port)
     c2 = MiniClient(server.host, server.port)
