@@ -60,6 +60,7 @@ class Transaction:
     participants: dict = field(default_factory=dict)  # table -> Participant
     stmt_seq: int = 0  # statement counter (savepoint granularity)
     first_wal_lsn: int = 0  # first redo LSN (checkpoint barrier)
+    pending_redo: list = field(default_factory=list)  # group-commit buffer
 
     def participant(self, table: str, tablet) -> Participant:
         p = self.participants.get(table)
@@ -101,11 +102,13 @@ class TransService:
                      snapshot=tx.snapshot)
         p = tx.participant(table, tablet)
         p.keys.append(key)
-        lsn = self._log({"op": "redo", "tx": tx.tx_id, "table": table,
-                         "key": list(key), "kind": op,
-                         "values": _jsonable(values)})
-        if tx.first_wal_lsn == 0 and lsn:
-            tx.first_wal_lsn = lsn
+        # redo buffers in the tx and ships in ONE replicated group append
+        # at commit (≙ the sliding window's group buffer batching —
+        # N writes cost one majority fsync, not N)
+        tx.pending_redo.append(
+            {"op": "redo", "tx": tx.tx_id, "table": table,
+             "key": list(key), "kind": op, "stmt": tx.stmt_seq,
+             "values": _jsonable(values)})
 
     def rollback_statement(self, tx: Transaction, stmt_seq: int,
                            stmt_writes: dict):
@@ -119,6 +122,9 @@ class TransService:
             p.tablet.abort(tx.tx_id, keys, min_stmt_seq=stmt_seq)
             # p.keys keeps earlier-statement entries; commit() tolerates
             # keys whose uncommitted versions were statement-aborted
+        # drop the statement's buffered redo (it never hit the WAL)
+        tx.pending_redo = [r for r in tx.pending_redo
+                           if r.get("stmt", 0) < stmt_seq]
 
     # ------------------------------------------------------------------
     def commit(self, tx: Transaction) -> int:
@@ -136,10 +142,13 @@ class TransService:
                 self._release_locks(tx)
                 return self.gts.get_ts()
             if len(parts) == 1:
-                # single-LS fast path (≙ one-phase commit optimization)
+                # single-LS fast path (≙ one-phase commit optimization):
+                # buffered redo + commit ship as one group append
                 version = self.gts.get_ts()
-                self._log({"op": "commit", "tx": tx.tx_id,
-                           "version": version})
+                self._log_batch(tx.pending_redo +
+                                [{"op": "commit", "tx": tx.tx_id,
+                                  "version": version}])
+                tx.pending_redo = []
                 parts[0].tablet.commit(tx.tx_id, version, parts[0].keys)
                 tx.state = TxState.CLEAR
                 self._live.pop(tx.tx_id, None)
@@ -148,14 +157,19 @@ class TransService:
 
             # ---- 2PC (≙ upstream/downstream committer state machine) ----
             tx.state = TxState.REDO_COMPLETE
+            records = list(tx.pending_redo)
             for p in parts:
                 p.state = TxState.PREPARE
                 p.prepare_version = self.gts.get_ts()
-                self._log({"op": "prepare", "tx": tx.tx_id,
-                           "table": p.table, "version": p.prepare_version})
+                records.append({"op": "prepare", "tx": tx.tx_id,
+                                "table": p.table,
+                                "version": p.prepare_version})
             version = max(p.prepare_version for p in parts)
             tx.state = TxState.PRE_COMMIT
-            self._log({"op": "commit", "tx": tx.tx_id, "version": version})
+            records.append({"op": "commit", "tx": tx.tx_id,
+                            "version": version})
+            self._log_batch(records)
+            tx.pending_redo = []
             tx.state = TxState.COMMIT
             for p in parts:
                 p.tablet.commit(tx.tx_id, version, p.keys)
@@ -171,7 +185,8 @@ class TransService:
                 return
             for p in tx.participants.values():
                 p.tablet.abort(tx.tx_id, p.keys)
-            self._log({"op": "abort", "tx": tx.tx_id})
+            # redo never reached the WAL (group commit): nothing to log
+            tx.pending_redo = []
             tx.state = TxState.ABORT
             self._live.pop(tx.tx_id, None)
             self._release_locks(tx)
@@ -184,6 +199,14 @@ class TransService:
     def _log(self, record: dict) -> int:
         if self.wal is not None:
             return self.wal.append([json.dumps(record).encode()])
+        return 0
+
+    def _log_batch(self, records: list) -> int:
+        """Group append: one majority-replicated fsync for the whole
+        batch (≙ LogSlidingWindow group buffer)."""
+        if self.wal is not None and records:
+            return self.wal.append(
+                [json.dumps(r).encode() for r in records])
         return 0
 
     def min_active_wal_lsn(self):
