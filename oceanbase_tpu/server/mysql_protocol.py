@@ -230,7 +230,7 @@ class _Conn:
             return
         stmt_id = self._next_stmt
         self._next_stmt += 1
-        self._stmts[stmt_id] = (sql, n_params)
+        self._stmts[stmt_id] = (sql, n_params, [T_VAR_STRING] * n_params)
         # PREPARE-OK: stmt id, 0 result columns (computed at execute),
         # n params, warnings
         self.send(b"\x00" + struct.pack("<IHHBH", stmt_id, 0, n_params,
@@ -254,32 +254,35 @@ class _Conn:
         if ent is None:
             self.send_err(1243, f"unknown prepared statement {stmt_id}")
             return
-        sql, n_params = ent
+        sql, n_params, bound_types = ent
         pos = 9  # id(4) + flags(1) + iteration_count(4)
         params: list = []
-        if n_params:
-            nb = (n_params + 7) // 8
-            null_bitmap = arg[pos:pos + nb]
-            pos += nb
-            new_params_bound = arg[pos]
-            pos += 1
-            types = []
-            if new_params_bound:
-                for _ in range(n_params):
-                    types.append(struct.unpack_from("<H", arg, pos)[0])
-                    pos += 2
-                self._stmts[stmt_id] = (sql, n_params)
-                self._stmt_types = types
-            else:
-                types = getattr(self, "_stmt_types", [T_VAR_STRING] *
-                                n_params)
-            for i in range(n_params):
-                if null_bitmap[i // 8] & (1 << (i % 8)):
-                    params.append(None)
-                    continue
-                t = types[i] & 0xFF
-                v, pos = self._read_binary_value(arg, pos, t)
-                params.append(v)
+        try:
+            if n_params:
+                nb = (n_params + 7) // 8
+                null_bitmap = arg[pos:pos + nb]
+                pos += nb
+                new_params_bound = arg[pos]
+                pos += 1
+                if new_params_bound:
+                    types = []
+                    for _ in range(n_params):
+                        types.append(struct.unpack_from("<H", arg, pos)[0])
+                        pos += 2
+                    # bound types persist PER STATEMENT for re-executes
+                    self._stmts[stmt_id] = (sql, n_params, types)
+                else:
+                    types = bound_types
+                for i in range(n_params):
+                    if null_bitmap[i // 8] & (1 << (i % 8)):
+                        params.append(None)
+                        continue
+                    t = types[i] & 0xFF
+                    v, pos = self._read_binary_value(arg, pos, t)
+                    params.append(v)
+        except (IndexError, struct.error) as e:
+            self.send_err(1064, f"malformed binary parameters: {e}")
+            return
         try:
             result = self.session.execute(sql, params=params)
         except Exception as e:  # noqa: BLE001 — protocol boundary
@@ -304,6 +307,25 @@ class _Conn:
             return struct.unpack_from("<f", buf, pos)[0], pos + 4
         if mtype == T_DOUBLE:
             return struct.unpack_from("<d", buf, pos)[0], pos + 8
+        if mtype in (7, 10, 12):   # TIMESTAMP / DATE / DATETIME (packed)
+            ln = buf[pos]
+            pos += 1
+            if ln == 0:
+                return "0000-00-00", pos
+            y, mo, d = struct.unpack_from("<HBB", buf, pos)
+            out = f"{y:04d}-{mo:02d}-{d:02d}"
+            if ln >= 7:
+                h, mi, sec = struct.unpack_from("<BBB", buf, pos + 4)
+                out += f" {h:02d}:{mi:02d}:{sec:02d}"
+            return out, pos + ln
+        if mtype == 11:            # TIME (packed)
+            ln = buf[pos]
+            pos += 1
+            if ln == 0:
+                return "00:00:00", pos
+            neg, _days, h, mi, sec = struct.unpack_from("<BIBBB", buf, pos)
+            sign = "-" if neg else ""
+            return f"{sign}{h:02d}:{mi:02d}:{sec:02d}", pos + ln
         # everything else ships as length-encoded string
         ln, pos = _read_lenenc(buf, pos)
         raw = buf[pos:pos + ln]
@@ -318,6 +340,10 @@ class _Conn:
         for name in names:
             t = result.dtypes.get(name)
             mtype, length, decimals = self._coltype(t)
+            if mtype == T_DATE:
+                # binary DATE rows use a packed format we don't emit;
+                # advertise VAR_STRING so the lenenc text value parses
+                mtype = T_VAR_STRING
             mtypes.append((mtype, t))
             payload = (lenenc_str(b"def") + lenenc_str(b"") * 3 +
                        lenenc_str(name.encode()) + lenenc_str(name.encode()) +

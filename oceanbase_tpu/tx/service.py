@@ -59,8 +59,10 @@ class Transaction:
     state: TxState = TxState.ACTIVE
     participants: dict = field(default_factory=dict)  # table -> Participant
     stmt_seq: int = 0  # statement counter (savepoint granularity)
-    first_wal_lsn: int = 0  # first redo LSN (checkpoint barrier)
-    pending_redo: list = field(default_factory=list)  # group-commit buffer
+    # group-commit buffer: redo lives here (and in the memtable) until the
+    # commit ships everything in one replicated append.  Unbounded for
+    # huge transactions — incremental pre-commit flush is an r2 item.
+    pending_redo: list = field(default_factory=list)
 
     def participant(self, table: str, tablet) -> Participant:
         p = self.participants.get(table)
@@ -209,14 +211,10 @@ class TransService:
                 [json.dumps(r).encode() for r in records])
         return 0
 
-    def min_active_wal_lsn(self):
-        """Oldest live transaction's first redo LSN — the checkpoint must
-        not advance the replay point past it (≙ clog recycle point bounded
-        by active tx)."""
-        with self._lock:
-            lsns = [tx.first_wal_lsn for tx in self._live.values()
-                    if tx.first_wal_lsn > 0]
-            return min(lsns) if lsns else None
+    # NOTE: with group commit, a live transaction has NO presence in the
+    # WAL (redo ships atomically with its commit record), so checkpoints
+    # no longer need a replay-point barrier at the oldest live tx — the
+    # pre-group-commit min_active_wal_lsn clamp was removed with it.
 
     # ------------------------------------------------------------------
     # recovery (≙ replayservice applying committed log to memtables)
@@ -247,6 +245,8 @@ class TransService:
                     ts.tablet.write(key, r["kind"], r["values"], rec["tx"])
                     ts.tablet.commit(rec["tx"], version, [key])
             elif op == "abort":
+                # only pre-group-commit WALs contain abort records; kept
+                # for replaying logs written by older versions
                 pending.pop(rec["tx"], None)
         return max_ts
 
