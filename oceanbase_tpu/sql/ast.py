@@ -189,6 +189,13 @@ class SetVarStmt:
 
 
 @dataclass
+class AlterTableStmt:
+    table: str
+    action: str                  # add_column | drop_column
+    column: object = None        # ColumnSpec for add, name str for drop
+
+
+@dataclass
 class AlterSystemStmt:
     action: str            # set | major_freeze | minor_freeze | checkpoint
     name: Optional[str] = None

@@ -764,6 +764,31 @@ class Parser:
 
     def parse_alter_system(self):
         self.expect_kw("alter")
+        if self.accept_kw("tables") or self.at_kw("table"):
+            self.accept_kw("table")
+            name = self.expect_ident()
+            t = self.next()  # 'add' lexes as ident, 'drop' as keyword
+            word = t.value
+            if word == "add":
+                if self.peek().kind == "ident" and \
+                        self.peek().value == "column":
+                    self.next()
+                cname = self.expect_ident()
+                dtype = self.parse_type()
+                nullable = True
+                if self.accept_kw("not"):
+                    self.expect_kw("null")
+                    nullable = False
+                return ast.AlterTableStmt(
+                    name, "add_column",
+                    ast.ColumnSpec(cname, dtype, nullable))
+            if word == "drop":
+                if self.peek().kind == "ident" and \
+                        self.peek().value == "column":
+                    self.next()
+                return ast.AlterTableStmt(name, "drop_column",
+                                          self.expect_ident())
+            raise ParseError(f"unsupported ALTER TABLE action {word!r}")
         self.expect_kw("system")
         if self.accept_kw("set"):
             name = self.expect_ident()

@@ -240,6 +240,19 @@ class Session:
             return self._set_var(stmt)
         if isinstance(stmt, ast.AlterSystemStmt):
             return self._alter_system(stmt)
+        if isinstance(stmt, ast.AlterTableStmt):
+            if self.db is None:
+                raise NotImplementedError("ALTER TABLE needs a Database")
+            if stmt.action == "add_column":
+                c = stmt.column
+                self._engine.alter_table(stmt.table, "add_column",
+                                         (c.name, c.dtype, c.nullable))
+            else:
+                self._engine.alter_table(stmt.table, "drop_column",
+                                         stmt.column)
+            self.catalog.invalidate(stmt.table)
+            self.catalog.schema_version += 1
+            return _ok()
         if isinstance(stmt, ast.TenantStmt):
             if self.db is None:
                 raise NotImplementedError("tenants need a Database")

@@ -141,6 +141,19 @@ class StorageEngine:
                 log=False)
         elif kind == "drop_table":
             self.tables.pop(op["name"], None)
+        elif kind == "alter_add":
+            n, k, p, s, nl = op["column"]
+            if op["table"] in self.tables:
+                self.alter_table(op["table"], "add_column",
+                                 (n, SqlType(TypeKind(k), p, s), nl),
+                                 log=False)
+        elif kind == "alter_drop":
+            if op["table"] in self.tables:
+                try:
+                    self.alter_table(op["table"], "drop_column",
+                                     op["column"], log=False)
+                except KeyError:
+                    pass
         elif kind == "add_segment":
             ts = self.tables.get(op["table"])
             if ts is not None:
@@ -200,6 +213,83 @@ class StorageEngine:
             if tdef.name in self.tables:
                 raise ValueError(f"table {tdef.name} exists")
             self._install_table(tdef)
+
+    def alter_table(self, name: str, action: str, column, log=True):
+        """Online schema change: ADD COLUMN (old segments serve NULLs for
+        it — no rewrite) / DROP COLUMN (data ages out via compaction).
+        ≙ the instant-DDL subset of ObDDLService column changes."""
+        with self._lock:
+            ts = self.tables[name]
+            tdef = ts.tdef
+            tab = ts.tablet
+            tablets = getattr(tab, "partitions", [tab])
+            if action == "add_column":
+                cname, dtype, nullable = column
+                if any(c.name == cname for c in tdef.columns):
+                    raise ValueError(f"column {cname!r} exists")
+                tdef.columns.append(ColumnDef(cname, dtype, nullable))
+                for t in tablets:
+                    t.columns.append(cname)
+                    t.types[cname] = dtype
+                if hasattr(tab, "part_col"):
+                    tab.columns.append(cname)
+                    tab.types[cname] = dtype
+                if log:
+                    self._log_meta({
+                        "op": "alter_add", "table": name, "column":
+                        [cname, dtype.kind.value, dtype.precision,
+                         dtype.scale, nullable]})
+            elif action == "drop_column":
+                cname = column
+                if cname in tdef.primary_key:
+                    raise ValueError("cannot drop a primary-key column")
+                if getattr(tab, "part_col", None) == cname:
+                    raise ValueError("cannot drop the partition column")
+                if not any(c.name == cname for c in tdef.columns):
+                    raise KeyError(f"unknown column {cname!r}")
+                tdef.columns = [c for c in tdef.columns if c.name != cname]
+                for t in tablets:
+                    if cname in t.columns:
+                        t.columns.remove(cname)
+                    t.types.pop(cname, None)
+                if hasattr(tab, "part_col"):
+                    if cname in tab.columns:
+                        tab.columns.remove(cname)
+                    tab.types.pop(cname, None)
+                # purge stored values so a later ADD COLUMN of the same
+                # name cannot resurrect them (no column-identity ids yet)
+                for t in tablets:
+                    for mt in [t.active] + t.frozen:
+                        with mt._lock:
+                            for head in mt._rows.values():
+                                v = head
+                                while v is not None:
+                                    v.values.pop(cname, None)
+                                    v = v.prev
+                    for i, seg in enumerate(list(t.segments)):
+                        if cname not in seg.columns:
+                            continue
+                        a, vv = seg.decode()
+                        a.pop(cname, None)
+                        vv.pop(cname, None)
+                        stypes = {k: v for k, v in seg.types.items()
+                                  if k != cname}
+                        new = Segment.build(
+                            seg.segment_id, seg.level, a, stypes,
+                            {k: x for k, x in vv.items() if x is not None},
+                            min_version=seg.min_version,
+                            max_version=seg.max_version)
+                        t.segments[i] = new
+                        if self.root is not None:
+                            new.save(self._segment_file(
+                                name, new.segment_id))
+                if log:
+                    self._log_meta({"op": "alter_drop", "table": name,
+                                    "column": cname})
+            else:
+                raise ValueError(action)
+            for t in tablets:
+                t.data_version += 1
 
     def drop_table(self, name: str):
         with self._lock:

@@ -282,15 +282,21 @@ def _rows_to_arrays(rows: dict, columns, types):
 
 def _stack_parts(parts, columns, types):
     """Stack (arrays, valids, _) parts preserving the hidden __deleted__
-    tombstone and __version__ commit-version columns."""
+    tombstone and __version__ commit-version columns.
+
+    A part MISSING a real column (segments written before an ALTER TABLE
+    ADD COLUMN) contributes NULLs for it — schema evolution without
+    rewriting old segments."""
     cols = list(columns) + ["__deleted__", "__version__"]
     arrays = {}
     valids = {}
     for c in cols:
         arrs = []
+        missing = []  # parallel flags: part lacked this column entirely
         for a, v, _ in parts:
             if c in a:
                 arrs.append(a[c])
+                missing.append(False)
             else:
                 n = len(next(iter(a.values())))
                 if c == "__deleted__":
@@ -298,19 +304,27 @@ def _stack_parts(parts, columns, types):
                 elif c == "__version__":
                     arrs.append(np.zeros(n, dtype=np.int64))
                 else:
-                    arrs.append(np.zeros(n, dtype=types[c].np_dtype))
+                    arrs.append(
+                        np.array([""] * n, dtype=object)
+                        if types[c].is_string
+                        else np.zeros(n, dtype=types[c].np_dtype))
+                missing.append(True)
         if any(x.dtype == object for x in arrs):
             arrs = [x.astype(object) for x in arrs]
         arrays[c] = np.concatenate(arrs) if arrs else np.zeros(0)
-        if c != "__deleted__":
+        if c not in ("__deleted__", "__version__"):
             vparts = []
-            has = any(v.get(c) is not None for _, v, _ in parts)
+            has = any(v.get(c) is not None for _, v, _ in parts) or \
+                any(m for m in missing)
             if has:
-                for a, v, _ in parts:
-                    n = len(a[c]) if c in a else 0
-                    vv = v.get(c)
-                    vparts.append(vv if vv is not None
-                                  else np.ones(n, dtype=bool))
+                for (a, v, _), m, arr in zip(parts, missing, arrs):
+                    n = len(arr)
+                    if m:
+                        vparts.append(np.zeros(n, dtype=bool))  # NULLs
+                    else:
+                        vv = v.get(c)
+                        vparts.append(vv if vv is not None
+                                      else np.ones(n, dtype=bool))
                 valids[c] = np.concatenate(vparts)
             else:
                 valids[c] = None
