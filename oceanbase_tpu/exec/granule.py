@@ -331,7 +331,7 @@ def segment_chunk_provider(tablet, snapshot: int):
 
         parts = []
         with tablet._lock:
-            for mt in [tablet.active] + tablet.frozen[::-1]:
+            for mt in tablet.memtables():
                 rows = mt.snapshot_rows(snapshot)
                 if rows:
                     from oceanbase_tpu.storage.tablet import _rows_to_arrays

@@ -56,7 +56,7 @@ class KvTable:
         key = self._key_of(key)
         snap = snapshot if snapshot is not None else \
             self.tenant.tx.gts.current()
-        for mt in [tablet.active] + tablet.frozen[::-1]:
+        for mt in tablet.memtables():
             v = mt.visible_version(key, snap)
             if v is not None:
                 if v.op == "delete":

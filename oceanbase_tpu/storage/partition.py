@@ -56,8 +56,18 @@ class PartitionedTablet:
         return sum(p.data_version for p in self.partitions)
 
     @property
-    def active(self):  # pragma: no cover - debug convenience
+    def active(self):
+        # callers needing ALL memtables must use memtables(); this exists
+        # only for interface compatibility with single-tablet code paths
         return self.partitions[0].active
+
+    def memtables(self):
+        """Every memtable across partitions, newest-first per partition."""
+        out = []
+        for p in self.partitions:
+            out.append(p.active)
+            out.extend(p.frozen[::-1])
+        return out
 
     @property
     def frozen(self):

@@ -240,6 +240,11 @@ class Tablet:
         return sum(s.n_rows for s in self.segments) + len(self.active) + \
             sum(len(m) for m in self.frozen)
 
+    def memtables(self):
+        """Active + frozen memtables, newest-first (interface shared with
+        PartitionedTablet for point-lookup/streaming paths)."""
+        return [self.active] + self.frozen[::-1]
+
     # -- segment management hooks (shared with PartitionedTablet) --------
     def add_segment(self, seg, part_idx=None):
         self.segments.append(seg)

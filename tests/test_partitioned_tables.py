@@ -72,6 +72,36 @@ def test_partitioned_flush_compact_recovery(tmp_path):
     db2.close()
 
 
+def test_kv_and_streaming_over_partitions(pdb):
+    db, s = pdb
+    s.execute("insert into t values (50, 1), (150, 2), (250, 3)")
+    kv = db.tenant().kv("t")
+    # point lookups must see memtables of EVERY partition
+    assert kv.get(150) == {"k": 150, "v": 2}
+    assert kv.get(250) == {"k": 250, "v": 3}
+    assert kv.get(999) is None
+    # streamed scan covers all partitions' memtables + segments
+    db.checkpoint()
+    s.execute("insert into t values (160, 4)")  # memtable, partition 1
+    from oceanbase_tpu.exec.granule import (
+        execute_streamed,
+        segment_chunk_provider,
+    )
+    from oceanbase_tpu.exec.ops import AggSpec
+    from oceanbase_tpu.exec.plan import ScalarAgg, TableScan
+    from oceanbase_tpu.expr import ir
+    from oceanbase_tpu.vector import to_numpy
+
+    plan = ScalarAgg(TableScan("t", rename={"k": "k", "v": "v"}),
+                     [AggSpec("s", "sum", ir.col("v")),
+                      AggSpec("c", "count_star")])
+    tablet = db.engine.tables["t"].tablet
+    out = to_numpy(execute_streamed(
+        plan, segment_chunk_provider(tablet, db.tx.gts.current()),
+        chunk_rows=2))
+    assert out["c"][0] == 4 and out["s"][0] == 10
+
+
 def test_partitioned_bulk_load(pdb):
     db, s = pdb
     db.catalog.load_numpy("u", {"k": np.arange(300),
