@@ -140,4 +140,118 @@ uint64_t obtpu_rle_runs_i64(const int64_t* in, uint64_t n,
     return count;
 }
 
+// ---------------------------------------------------------------------------
+// CSV tokenizer (direct-load path): scans a whole buffer into row-major
+// (offset, length) pairs per field.  Handles RFC-4180-style double-quoted
+// fields with "" escapes, \n and \r\n terminators.  Returns the number of
+// rows tokenized, 0 on structural error (ragged row), with *err_row set.
+// The (offset,length) of a quoted field excludes the quotes; embedded ""
+// stays doubled (caller unescapes the rare fields that contain quotes —
+// flagged via the high bit of the length).
+// ---------------------------------------------------------------------------
+
+uint64_t obtpu_csv_tokenize(const uint8_t* buf, uint64_t len, uint8_t delim,
+                            uint64_t n_cols, uint64_t* offsets,
+                            uint32_t* lengths, uint64_t max_rows,
+                            uint64_t* err_row) {
+    uint64_t pos = 0, row = 0;
+    *err_row = 0;
+    while (pos < len && row < max_rows) {
+        uint64_t col = 0;
+        bool row_done = false;
+        while (!row_done) {
+            if (col >= n_cols) { *err_row = row + 1; return 0; }
+            uint64_t field_start, field_len;
+            bool quoted_escape = false;
+            if (pos < len && buf[pos] == '"') {
+                pos++;
+                field_start = pos;
+                while (pos < len) {
+                    if (buf[pos] == '"') {
+                        if (pos + 1 < len && buf[pos + 1] == '"') {
+                            quoted_escape = true;
+                            pos += 2;
+                            continue;
+                        }
+                        break;
+                    }
+                    pos++;
+                }
+                field_len = pos - field_start;
+                if (pos < len) pos++;  // closing quote
+            } else {
+                field_start = pos;
+                while (pos < len && buf[pos] != delim && buf[pos] != '\n' &&
+                       buf[pos] != '\r') {
+                    pos++;
+                }
+                field_len = pos - field_start;
+            }
+            uint64_t idx = row * n_cols + col;
+            offsets[idx] = field_start;
+            lengths[idx] = (uint32_t)field_len |
+                           (quoted_escape ? 0x80000000u : 0);
+            col++;
+            if (pos >= len) { row_done = true; }
+            else if (buf[pos] == (uint8_t)delim) { pos++; }
+            else if (buf[pos] == '\r') {
+                pos++;
+                if (pos < len && buf[pos] == '\n') pos++;
+                row_done = true;
+            } else if (buf[pos] == '\n') { pos++; row_done = true; }
+        }
+        if (col != n_cols) { *err_row = row + 1; return 0; }
+        row++;
+        // skip trailing blank line
+        if (pos >= len) break;
+    }
+    return row;
+}
+
+// Batch int64 parse over tokenized fields: empty/invalid -> null.
+// Returns count of successfully parsed values.
+uint64_t obtpu_parse_int64_fields(const uint8_t* buf, const uint64_t* offs,
+                                  const uint32_t* lens, uint64_t n,
+                                  int64_t scale_pow10, int64_t* out,
+                                  uint8_t* valid) {
+    uint64_t ok = 0;
+    for (uint64_t i = 0; i < n; i++) {
+        uint32_t ln = lens[i] & 0x7FFFFFFF;
+        const uint8_t* p = buf + offs[i];
+        if (ln == 0) { valid[i] = 0; out[i] = 0; continue; }
+        uint64_t j = 0;
+        bool neg = false;
+        if (p[0] == '-' || p[0] == '+') { neg = (p[0] == '-'); j = 1; }
+        int64_t ip = 0, fp = 0, fdigits = 1;
+        bool in_frac = false, any = false, bad = false;
+        for (; j < ln; j++) {
+            uint8_t c = p[j];
+            if (c == '.') {
+                if (in_frac || scale_pow10 == 1) { bad = true; break; }
+                in_frac = true;
+            } else if (c >= '0' && c <= '9') {
+                any = true;
+                if (in_frac) {
+                    if (fdigits < scale_pow10) {
+                        fp = fp * 10 + (c - '0');
+                        fdigits *= 10;
+                    }
+                    // extra digits beyond the scale truncate
+                } else {
+                    ip = ip * 10 + (c - '0');
+                }
+            } else { bad = true; break; }
+        }
+        if (bad || !any) { valid[i] = 0; out[i] = 0; continue; }
+        while (fdigits < scale_pow10) {
+            fp *= 10; fdigits *= 10;
+        }
+        int64_t v = ip * scale_pow10 + fp;
+        out[i] = neg ? -v : v;
+        valid[i] = 1;
+        ok++;
+    }
+    return ok;
+}
+
 }  // extern "C"

@@ -61,6 +61,17 @@ def _load():
         lib.obtpu_rle_runs_i64.restype = ctypes.c_uint64
         lib.obtpu_rle_runs_i64.argtypes = [
             i64p, ctypes.c_uint64, u64p, ctypes.c_uint64]
+        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+        bytep = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.obtpu_csv_tokenize.restype = ctypes.c_uint64
+        lib.obtpu_csv_tokenize.argtypes = [
+            bytep, ctypes.c_uint64, ctypes.c_uint8, ctypes.c_uint64,
+            u64p, u32p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.obtpu_parse_int64_fields.restype = ctypes.c_uint64
+        lib.obtpu_parse_int64_fields.argtypes = [
+            bytep, u64p, u32p, ctypes.c_uint64, ctypes.c_int64, i64p,
+            bytep]
         _lib = lib
         return _lib
 
@@ -173,6 +184,76 @@ def delta_varint_decode(buf: bytes, n: int) -> np.ndarray:
     except IndexError:
         raise ValueError("corrupt varint payload (truncated)") from None
     return out_l
+
+
+# ---------------------------------------------------------------------------
+# CSV tokenizer + field parsers (direct-load fast path; python csv module
+# remains the fallback and the oracle for quoting semantics)
+# ---------------------------------------------------------------------------
+
+
+def csv_tokenize(data: bytes, n_cols: int, delimiter: str = ","):
+    """-> (buf, offsets[n_rows*n_cols], lengths, n_rows) or None when the
+    native library is unavailable or the file is ragged (caller falls
+    back to the python csv module)."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    approx_rows = data.count(b"\n") + 2
+    offsets = np.empty(approx_rows * n_cols, dtype=np.uint64)
+    lengths = np.empty(approx_rows * n_cols, dtype=np.uint32)
+    err = ctypes.c_uint64(0)
+    n_rows = int(lib.obtpu_csv_tokenize(
+        np.ascontiguousarray(buf), len(buf), ord(delimiter), n_cols,
+        offsets, lengths, approx_rows, ctypes.byref(err)))
+    if n_rows == 0 and err.value:
+        return None
+    return buf, offsets[:n_rows * n_cols], lengths[:n_rows * n_cols], n_rows
+
+
+def parse_int64_fields(buf: np.ndarray, offsets, lengths,
+                       scale: int = 0):
+    """Batch-parse tokenized fields into scaled int64 + validity."""
+    lib = _load()
+    n = len(offsets)
+    out = np.empty(n, dtype=np.int64)
+    valid = np.empty(n, dtype=np.uint8)
+    if lib is None:
+        for i in range(n):
+            ln = int(lengths[i]) & 0x7FFFFFFF
+            s = bytes(buf[int(offsets[i]):int(offsets[i]) + ln]).decode()
+            try:
+                if scale:
+                    from decimal import Decimal
+
+                    out[i] = int(Decimal(s).scaleb(scale))
+                else:
+                    out[i] = int(s)
+                valid[i] = 1
+            except Exception:  # noqa: BLE001
+                out[i] = 0
+                valid[i] = 0
+        return out, valid.astype(bool)
+    lib.obtpu_parse_int64_fields(
+        np.ascontiguousarray(buf), np.ascontiguousarray(offsets),
+        np.ascontiguousarray(lengths), n, 10 ** scale, out, valid)
+    return out, valid.astype(bool)
+
+
+def field_strings(buf: np.ndarray, offsets, lengths) -> np.ndarray:
+    """Materialize tokenized fields as python strings (unescaping the rare
+    quoted-quote fields flagged in the length high bit)."""
+    out = np.empty(len(offsets), dtype=object)
+    data = buf.tobytes()
+    for i in range(len(offsets)):
+        ln = int(lengths[i])
+        esc = bool(ln & 0x80000000)
+        ln &= 0x7FFFFFFF
+        o = int(offsets[i])
+        s = data[o:o + ln].decode(errors="replace")
+        out[i] = s.replace('""', '"') if esc else s
+    return out
 
 
 def rle_run_starts(values: np.ndarray) -> np.ndarray:

@@ -327,10 +327,16 @@ class Session:
 
     def _load_data(self, stmt: ast.LoadDataStmt) -> Result:
         """LOAD DATA INFILE: CSV -> direct-load baseline segment
-        (≙ src/storage/direct_load bypassing the memtable)."""
+        (≙ src/storage/direct_load bypassing the memtable).  The hot path
+        tokenizes + parses numerics in the native library; the python csv
+        module is the fallback (and the quoting-semantics oracle)."""
+        td = self.catalog.table_def(stmt.table)
+        fast = self._load_data_native(stmt, td)
+        if fast is not None:
+            arrays, valids, n = fast
+            return self._finish_load(stmt, td, arrays, valids, n)
         import csv
 
-        td = self.catalog.table_def(stmt.table)
         cols = [[] for _ in td.columns]
         with open(stmt.path, newline="") as f:
             reader = csv.reader(f, delimiter=stmt.delimiter)
@@ -372,14 +378,82 @@ class Session:
                                                  dtype=cdef.dtype.np_dtype))
             if not valid.all():
                 valids[cdef.name] = valid
-        if self.db is not None:
+        return self._finish_load(stmt, td, arrays, valids, n)
+
+    def _load_data_native(self, stmt, td):
+        """Native CSV fast path -> (arrays, valids, n) or None to fall
+        back (no native lib / ragged file / exotic types)."""
+        from oceanbase_tpu import native
+        from oceanbase_tpu.datatypes import DATE_EPOCH
+
+        with open(stmt.path, "rb") as f:
+            data = f.read()
+        n_cols = len(td.columns)
+        tok = native.csv_tokenize(data, n_cols, stmt.delimiter)
+        if tok is None:
+            return None
+        buf, offsets, lengths, n_rows = tok
+        if n_rows <= stmt.skip_lines:
+            return {}, {}, 0
+        start = stmt.skip_lines * n_cols
+        offsets = offsets[start:]
+        lengths = lengths[start:]
+        n = n_rows - stmt.skip_lines
+        arrays, valids = {}, {}
+        for j, cdef in enumerate(td.columns):
+            offs = np.ascontiguousarray(offsets[j::n_cols])
+            lens = np.ascontiguousarray(lengths[j::n_cols])
+            k = cdef.dtype.kind
+            if k == TypeKind.INT:
+                out, valid = native.parse_int64_fields(buf, offs, lens, 0)
+                arrays[cdef.name] = out
+            elif k == TypeKind.DECIMAL:
+                out, valid = native.parse_int64_fields(
+                    buf, offs, lens, cdef.dtype.scale)
+                arrays[cdef.name] = out
+            elif k == TypeKind.DATE:
+                strs = native.field_strings(buf, offs, lens)
+                valid = np.array([s != "" and s.upper() != "\\N"
+                                  for s in strs])
+                days = np.zeros(n, dtype=np.int32)
+                if valid.any():
+                    d64 = np.array(
+                        [s if v else "1970-01-01"
+                         for s, v in zip(strs, valid)],
+                        dtype="datetime64[D]")
+                    days = (d64 - DATE_EPOCH).astype(np.int32)
+                arrays[cdef.name] = days
+            elif k in (TypeKind.FLOAT, TypeKind.DOUBLE):
+                strs = native.field_strings(buf, offs, lens)
+                valid = np.array([s != "" and s.upper() != "\\N"
+                                  for s in strs])
+                vals = np.zeros(n, dtype=cdef.dtype.np_dtype)
+                for i, (s, v) in enumerate(zip(strs, valid)):
+                    if v:
+                        try:
+                            vals[i] = float(s)
+                        except ValueError:
+                            valid[i] = False
+                arrays[cdef.name] = vals
+            elif cdef.dtype.is_string:
+                strs = native.field_strings(buf, offs, lens)
+                valid = np.array([s != "" and s != "\\N" for s in strs])
+                arrays[cdef.name] = strs
+            else:
+                return None  # exotic type: python fallback handles it
+            if not valid.all():
+                valids[cdef.name] = valid
+        return arrays, valids, n
+
+    def _finish_load(self, stmt, td, arrays, valids, n) -> Result:
+        if self.db is None:
+            raise NotImplementedError("LOAD DATA needs a Database")
+        if n:
             self._engine.bulk_load(stmt.table, arrays, valids or None,
                                    version=self._txsvc.gts.get_ts())
-            self.catalog.invalidate(stmt.table)
-            td.row_count = self._engine.tables[stmt.table] \
-                .tablet.row_count_estimate()
-        else:
-            raise NotImplementedError("LOAD DATA needs a Database")
+        self.catalog.invalidate(stmt.table)
+        td.row_count = self._engine.tables[stmt.table] \
+            .tablet.row_count_estimate()
         return _ok(rowcount=n)
 
     def _lock_table(self, stmt: ast.LockTableStmt) -> Result:
