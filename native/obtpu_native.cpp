@@ -205,6 +205,12 @@ uint64_t obtpu_csv_tokenize(const uint8_t* buf, uint64_t len, uint8_t delim,
         // skip trailing blank line
         if (pos >= len) break;
     }
+    if (pos < len && row >= max_rows) {
+        // allocation too small (caller's row estimate missed the line
+        // terminator style): error rather than silently truncate
+        *err_row = row;
+        return 0;
+    }
     return row;
 }
 
@@ -222,7 +228,9 @@ uint64_t obtpu_parse_int64_fields(const uint8_t* buf, const uint64_t* offs,
         uint64_t j = 0;
         bool neg = false;
         if (p[0] == '-' || p[0] == '+') { neg = (p[0] == '-'); j = 1; }
+        const int64_t IP_LIMIT = (0x7FFFFFFFFFFFFFFFLL - 9) / 10;
         int64_t ip = 0, fp = 0, fdigits = 1;
+        int first_dropped = -1;
         bool in_frac = false, any = false, bad = false;
         for (; j < ln; j++) {
             uint8_t c = p[j];
@@ -235,9 +243,11 @@ uint64_t obtpu_parse_int64_fields(const uint8_t* buf, const uint64_t* offs,
                     if (fdigits < scale_pow10) {
                         fp = fp * 10 + (c - '0');
                         fdigits *= 10;
+                    } else if (first_dropped < 0) {
+                        first_dropped = c - '0';
                     }
-                    // extra digits beyond the scale truncate
                 } else {
+                    if (ip > IP_LIMIT) { bad = true; break; }  // overflow
                     ip = ip * 10 + (c - '0');
                 }
             } else { bad = true; break; }
@@ -245,6 +255,14 @@ uint64_t obtpu_parse_int64_fields(const uint8_t* buf, const uint64_t* offs,
         if (bad || !any) { valid[i] = 0; out[i] = 0; continue; }
         while (fdigits < scale_pow10) {
             fp *= 10; fdigits *= 10;
+        }
+        if (first_dropped >= 5) {
+            // round half away from zero (matches the python oracle)
+            fp += 1;
+            if (fp >= scale_pow10) { fp = 0; ip += 1; }
+        }
+        if (ip > (0x7FFFFFFFFFFFFFFFLL - fp) / scale_pow10) {
+            valid[i] = 0; out[i] = 0; continue;  // scaled overflow
         }
         int64_t v = ip * scale_pow10 + fp;
         out[i] = neg ? -v : v;

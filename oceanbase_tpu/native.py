@@ -200,7 +200,9 @@ def csv_tokenize(data: bytes, n_cols: int, delimiter: str = ","):
     if lib is None:
         return None
     buf = np.frombuffer(data, dtype=np.uint8)
-    approx_rows = data.count(b"\n") + 2
+    # upper bound on rows: every row ends with \n or a lone \r (counting
+    # \r\n twice only over-allocates)
+    approx_rows = data.count(b"\n") + data.count(b"\r") + 2
     offsets = np.empty(approx_rows * n_cols, dtype=np.uint64)
     lengths = np.empty(approx_rows * n_cols, dtype=np.uint32)
     err = ctypes.c_uint64(0)
@@ -241,11 +243,12 @@ def parse_int64_fields(buf: np.ndarray, offsets, lengths,
     return out, valid.astype(bool)
 
 
-def field_strings(buf: np.ndarray, offsets, lengths) -> np.ndarray:
+def field_strings(buf, offsets, lengths) -> np.ndarray:
     """Materialize tokenized fields as python strings (unescaping the rare
-    quoted-quote fields flagged in the length high bit)."""
+    quoted-quote fields flagged in the length high bit).  ``buf`` may be
+    the original bytes object (no copy) or a uint8 array."""
     out = np.empty(len(offsets), dtype=object)
-    data = buf.tobytes()
+    data = buf if isinstance(buf, (bytes, bytearray)) else buf.tobytes()
     for i in range(len(offsets)):
         ln = int(lengths[i])
         esc = bool(ln & 0x80000000)

@@ -764,8 +764,8 @@ class Parser:
 
     def parse_alter_system(self):
         self.expect_kw("alter")
-        if self.accept_kw("tables") or self.at_kw("table"):
-            self.accept_kw("table")
+        if self.at_kw("table"):
+            self.next()
             name = self.expect_ident()
             t = self.next()  # 'add' lexes as ident, 'drop' as keyword
             word = t.value

@@ -400,19 +400,40 @@ class Session:
         lengths = lengths[start:]
         n = n_rows - stmt.skip_lines
         arrays, valids = {}, {}
+
+        def _check_numeric(valid, offs, lens, colname):
+            # python-oracle semantics: garbage (non-empty, non-\N)
+            # numeric cells ABORT the load instead of nulling silently
+            empty = (lens & 0x7FFFFFFF) == 0
+            suspicious = ~valid & ~empty
+            if suspicious.any():
+                idx = np.nonzero(suspicious)[0]
+                cells = native.field_strings(
+                    data, np.ascontiguousarray(offs[idx]),
+                    np.ascontiguousarray(lens[idx]))
+                for row_i, cell in zip(idx, cells):
+                    if cell.upper() != "\\N":
+                        raise ValueError(
+                            f"row {int(row_i) + 1 + stmt.skip_lines}: "
+                            f"invalid value {cell!r} for column "
+                            f"{colname!r}")
+            return valid
+
         for j, cdef in enumerate(td.columns):
             offs = np.ascontiguousarray(offsets[j::n_cols])
             lens = np.ascontiguousarray(lengths[j::n_cols])
             k = cdef.dtype.kind
             if k == TypeKind.INT:
                 out, valid = native.parse_int64_fields(buf, offs, lens, 0)
+                valid = _check_numeric(valid, offs, lens, cdef.name)
                 arrays[cdef.name] = out
             elif k == TypeKind.DECIMAL:
                 out, valid = native.parse_int64_fields(
                     buf, offs, lens, cdef.dtype.scale)
+                valid = _check_numeric(valid, offs, lens, cdef.name)
                 arrays[cdef.name] = out
             elif k == TypeKind.DATE:
-                strs = native.field_strings(buf, offs, lens)
+                strs = native.field_strings(data, offs, lens)
                 valid = np.array([s != "" and s.upper() != "\\N"
                                   for s in strs])
                 days = np.zeros(n, dtype=np.int32)
@@ -424,19 +445,22 @@ class Session:
                     days = (d64 - DATE_EPOCH).astype(np.int32)
                 arrays[cdef.name] = days
             elif k in (TypeKind.FLOAT, TypeKind.DOUBLE):
-                strs = native.field_strings(buf, offs, lens)
+                strs = native.field_strings(data, offs, lens)
                 valid = np.array([s != "" and s.upper() != "\\N"
                                   for s in strs])
                 vals = np.zeros(n, dtype=cdef.dtype.np_dtype)
-                for i, (s, v) in enumerate(zip(strs, valid)):
+                for i, (sv, v) in enumerate(zip(strs, valid)):
                     if v:
                         try:
-                            vals[i] = float(s)
+                            vals[i] = float(sv)
                         except ValueError:
-                            valid[i] = False
+                            raise ValueError(
+                                f"row {i + 1 + stmt.skip_lines}: invalid "
+                                f"value {sv!r} for column "
+                                f"{cdef.name!r}") from None
                 arrays[cdef.name] = vals
             elif cdef.dtype.is_string:
-                strs = native.field_strings(buf, offs, lens)
+                strs = native.field_strings(data, offs, lens)
                 valid = np.array([s != "" and s != "\\N" for s in strs])
                 arrays[cdef.name] = strs
             else:

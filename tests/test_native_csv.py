@@ -32,6 +32,50 @@ def test_tokenizer_ragged_returns_none():
     assert native.csv_tokenize(data, 3) is None
 
 
+def test_load_semantics_match_python_oracle(tmp_path):
+    # review regressions: lone-CR endings, int overflow, decimal rounding,
+    # garbage cells — native path must match the python path's semantics
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+
+    cr = tmp_path / "cr.csv"
+    cr.write_bytes(b"1,10\r2,20\r3,30\r4,40\r")
+    s.execute("create table c1 (k int primary key, v int)")
+    r = s.execute(f"load data infile '{cr}' into table c1 "
+                  f"fields terminated by ','")
+    assert r.rowcount == 4  # no silent truncation
+
+    ov = tmp_path / "ov.csv"
+    ov.write_text("1,99999999999999999999999\n")
+    s.execute("create table c2 (k int primary key, v int)")
+    with pytest.raises(ValueError):
+        s.execute(f"load data infile '{ov}' into table c2 "
+                  f"fields terminated by ','")
+
+    rd = tmp_path / "rd.csv"
+    rd.write_text("1,2.555\n2,-2.555\n")
+    s.execute("create table c3 (k int primary key, v decimal(10,2))")
+    s.execute(f"load data infile '{rd}' into table c3 "
+              f"fields terminated by ','")
+    assert s.execute("select v from c3 order by k").rows() == \
+        [(2.56,), (-2.56,)]
+
+    g = tmp_path / "g.csv"
+    g.write_text("1,abc\n")
+    s.execute("create table c4 (k int primary key, v int)")
+    with pytest.raises(ValueError):
+        s.execute(f"load data infile '{g}' into table c4 "
+                  f"fields terminated by ','")
+    db.close()
+
+
+def test_alter_tables_typo_rejected():
+    from oceanbase_tpu.sql.parser import ParseError, parse_sql
+
+    with pytest.raises(ParseError):
+        parse_sql("alter tables t add column x int")
+
+
 def test_native_load_matches_python_path(tmp_path, rng):
     n = 5000
     ks = np.arange(n)
