@@ -56,7 +56,7 @@ def _gen_query(rng) -> str:
         "not (a > 0)", "a > 0 or b = 2", "length(s) = 4",
     ]
     aggs = ["count(*)", "sum(a)", "min(f)", "max(a)", "avg(a)", "count(b)"]
-    shape = rng.integers(0, 4)
+    shape = rng.integers(0, 7)
     where = ""
     if rng.random() < 0.8:
         k = int(rng.integers(1, 3))
@@ -74,6 +74,21 @@ def _gen_query(rng) -> str:
         agg = rng.choice(aggs)
         return (f"select b, {agg} as agg1 from t1{where} "
                 f"group by b order by b")
+    if shape == 3:      # window functions
+        wf = rng.choice([
+            "row_number() over (partition by s order by a, f)",
+            "rank() over (partition by b order by a)",
+            "sum(a) over (partition by s)",
+            "count(*) over (partition by b order by a, f)",
+        ])
+        return f"select a, s, {wf} as w from t1{where} order by a, f, s"
+    if shape == 4:      # CTE + derived table
+        return (f"with base as (select a, b, s from t1{where}) "
+                f"select s, count(*) as n from base group by s order by s")
+    if shape == 5:      # set operation
+        op = rng.choice(["union", "union all", "except", "intersect"])
+        return (f"select b from t1{where} {op} "
+                f"select x from t2 order by 1")
     # join + aggregate
     return (f"select s, count(*) as n, sum(y) as sy from t1, t2 "
             f"where b = x{' and ' + rng.choice(preds) if rng.random() < 0.5 else ''} "
