@@ -140,6 +140,17 @@ class InsertStmt:
     columns: list            # list[str] or [] for all
     rows: list = None        # list[list[Expr]] for VALUES
     select: SelectStmt = None
+    replace: bool = False    # REPLACE INTO: delete-then-insert semantics
+
+
+@dataclass
+class TruncateStmt:
+    table: str
+
+
+@dataclass
+class ShowCreateStmt:
+    table: str
 
 
 @dataclass

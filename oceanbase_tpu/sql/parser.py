@@ -128,6 +128,16 @@ class Parser:
             return self.parse_drop()
         if self.peek().kind == "ident" and self.peek().value == "load":
             return self.parse_load_data()
+        if self.peek().kind == "ident" and self.peek().value == "truncate":
+            self.next()
+            self.accept_kw("table")
+            return ast.TruncateStmt(self.expect_ident())
+        if self.peek().kind == "ident" and self.peek().value == "replace":
+            self.next()
+            self.expect_kw("into")
+            stmt = self._parse_insert_body()
+            stmt.replace = True
+            return stmt
         if self.peek().kind == "ident" and self.peek().value == "lock":
             self.next()
             self.expect_kw("tables")
@@ -157,6 +167,9 @@ class Parser:
                 return ast.ShowStmt("variables")
             if self.accept_kw("parameters"):
                 return ast.ShowStmt("parameters")
+            if self.accept_kw("create"):
+                self.expect_kw("table")
+                return ast.ShowCreateStmt(self.expect_ident())
             self.expect_kw("tables")
             return ast.ShowTablesStmt()
         if self.at_kw("describe"):
@@ -962,6 +975,9 @@ class Parser:
     def parse_insert(self):
         self.expect_kw("insert")
         self.expect_kw("into")
+        return self._parse_insert_body()
+
+    def _parse_insert_body(self):
         name = self.expect_ident()
         cols = []
         if self.accept_op("("):

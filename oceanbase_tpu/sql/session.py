@@ -263,6 +263,43 @@ class Session:
             return _ok()
         if isinstance(stmt, ast.LoadDataStmt):
             return self._load_data(stmt)
+        if isinstance(stmt, ast.TruncateStmt):
+            if self.db is None:
+                raise NotImplementedError("TRUNCATE needs a Database")
+            self.catalog.table_def(stmt.table)  # existence check
+            # WAL barrier so replay discards pre-truncate redo
+            self._txsvc._log({"op": "truncate", "table": stmt.table})
+            self._engine.truncate_table(stmt.table)
+            self.catalog.invalidate(stmt.table)
+            return _ok()
+        if isinstance(stmt, ast.ShowCreateStmt):
+            td = self.catalog.table_def(stmt.table)
+            parts = []
+            for c in td.columns:
+                bits = [c.name, str(c.dtype)]
+                if not c.nullable:
+                    bits.append("NOT NULL")
+                if c.name in getattr(td, "auto_increment_cols", []):
+                    bits.append("AUTO_INCREMENT")
+                parts.append("  " + " ".join(bits))
+            if td.primary_key:
+                parts.append("  PRIMARY KEY (" +
+                             ", ".join(td.primary_key) + ")")
+            text = (f"CREATE TABLE {td.name} (\n" + ",\n".join(parts) +
+                    "\n)")
+            if td.partition:
+                pcol, bounds = td.partition
+                ps = [f"PARTITION p{i} VALUES LESS THAN ({b})"
+                      for i, b in enumerate(bounds)]
+                ps.append(f"PARTITION p{len(bounds)} VALUES LESS THAN "
+                          f"MAXVALUE")
+                text += (f" PARTITION BY RANGE ({pcol}) (" +
+                         ", ".join(ps) + ")")
+            return Result(
+                ["table", "create_table"],
+                {"table": np.array([td.name], dtype=object),
+                 "create_table": np.array([text], dtype=object)},
+                {}, {}, rowcount=1)
         if isinstance(stmt, ast.SequenceStmt):
             seqs = self.tenant.sequences if self.tenant is not None else None
             if seqs is None:
@@ -826,12 +863,25 @@ class Session:
                 self._fill_auto_increment(td, values)
                 rows_values.append(values)
         tablet = self._engine.tables[stmt.table].tablet
+        replace = getattr(stmt, "replace", False)
+        kv = None
+        if replace and self.tenant is not None:
+            from oceanbase_tpu.kv import KvTable
+
+            kv = KvTable(self.tenant, stmt.table)
 
         def op(tx):
             for values in rows_values:
                 key = tablet.make_key(values)
-                self._txsvc.write(tx, stmt.table, tablet, key, "insert",
-                                 values)
+                kind = "insert"
+                if replace:
+                    # REPLACE INTO: newest version wins over an existing
+                    # row (≙ REPLACE as delete+insert, here one update)
+                    existing = kv.get(key, snapshot=tx.snapshot) \
+                        if kv is not None else None
+                    kind = "update" if existing is not None else "insert"
+                self._txsvc.write(tx, stmt.table, tablet, key, kind,
+                                  values)
 
         self._run_in_tx(op)
         self.catalog.invalidate(stmt.table)

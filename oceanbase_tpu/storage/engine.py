@@ -141,6 +141,9 @@ class StorageEngine:
                 log=False)
         elif kind == "drop_table":
             self.tables.pop(op["name"], None)
+        elif kind == "truncate":
+            if op["table"] in self.tables:
+                self.truncate_table(op["table"], log=False)
         elif kind == "alter_add":
             n, k, p, s, nl = op["column"]
             if op["table"] in self.tables:
@@ -290,6 +293,18 @@ class StorageEngine:
                 raise ValueError(action)
             for t in tablets:
                 t.data_version += 1
+
+    def truncate_table(self, name: str, log=True):
+        """Drop all data, keep the schema: reinstall a fresh tablet
+        (segments unlinked; ≙ TRUNCATE as fast DDL, not row deletes)."""
+        with self._lock:
+            ts = self.tables[name]
+            tdef = ts.tdef
+            del self.tables[name]
+            self._install_table(tdef, log=False)
+            self.tables[name].tdef.row_count = 0
+            if log:
+                self._log_meta({"op": "truncate", "table": name})
 
     def drop_table(self, name: str):
         with self._lock:

@@ -248,6 +248,11 @@ class TransService:
                 # only pre-group-commit WALs contain abort records; kept
                 # for replaying logs written by older versions
                 pending.pop(rec["tx"], None)
+            elif op == "truncate":
+                # replayed in log order: discard everything replayed into
+                # the table so far (≙ TRUNCATE barrier in the redo stream)
+                if rec["table"] in engine.tables:
+                    engine.truncate_table(rec["table"], log=False)
         return max_ts
 
 

@@ -1,0 +1,59 @@
+"""TRUNCATE, REPLACE INTO, SHOW CREATE TABLE."""
+
+import pytest
+
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.tx.errors import DuplicateKey
+
+
+def test_truncate_and_recovery(tmp_path):
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 1), (2, 2)")
+    db.checkpoint()
+    s.execute("insert into t values (3, 3)")
+    s.execute("truncate table t")
+    assert s.execute("select count(*) from t").rows() == [(0,)]
+    s.execute("insert into t values (9, 9)")
+    assert s.execute("select k from t").rows() == [(9,)]
+    # crash: WAL replay must respect the truncate barrier
+    db.close()
+    db2 = Database(root)
+    assert db2.session().execute("select k from t").rows() == [(9,)]
+    db2.close()
+
+
+def test_replace_into(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 10)")
+    with pytest.raises(DuplicateKey):
+        s.execute("insert into t values (1, 20)")
+    s.execute("replace into t values (1, 20), (2, 22)")
+    assert s.execute("select k, v from t order by k").rows() == \
+        [(1, 20), (2, 22)]
+    # replace over a flushed row
+    db.checkpoint()
+    s.execute("replace into t values (1, 30)")
+    assert s.execute("select v from t where k = 1").rows() == [(30,)]
+    db.close()
+
+
+def test_show_create_table(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (id int primary key auto_increment, "
+              "v decimal(10,2) not null, name varchar(20)) "
+              "partition by range (id) ("
+              "partition p0 values less than (100), "
+              "partition p1 values less than maxvalue)")
+    r = s.execute("show create table t")
+    text = r.rows()[0][1]
+    assert "AUTO_INCREMENT" in text
+    assert "PRIMARY KEY (id)" in text
+    assert "NOT NULL" in text
+    assert "PARTITION BY RANGE (id)" in text and "MAXVALUE" in text
+    db.close()
