@@ -49,15 +49,16 @@ class KvTable:
         self.tenant.catalog.invalidate(self.table)
 
     def get(self, key, columns: Optional[list] = None,
-            snapshot: int | None = None) -> Optional[dict]:
+            snapshot: int | None = None, tx_id: int = 0) -> Optional[dict]:
         """Point lookup: memtables newest-first, then segments newest-first
-        (≙ table api GET riding the LSM read path)."""
+        (≙ table api GET riding the LSM read path).  ``tx_id`` makes the
+        transaction's own uncommitted writes visible."""
         tablet = self.ts.tablet
         key = self._key_of(key)
         snap = snapshot if snapshot is not None else \
             self.tenant.tx.gts.current()
         for mt in tablet.memtables():
-            v = mt.visible_version(key, snap)
+            v = mt.visible_version(key, snap, tx_id)
             if v is not None:
                 if v.op == "delete":
                     return None

@@ -264,14 +264,7 @@ class Session:
         if isinstance(stmt, ast.LoadDataStmt):
             return self._load_data(stmt)
         if isinstance(stmt, ast.TruncateStmt):
-            if self.db is None:
-                raise NotImplementedError("TRUNCATE needs a Database")
-            self.catalog.table_def(stmt.table)  # existence check
-            # WAL barrier so replay discards pre-truncate redo
-            self._txsvc._log({"op": "truncate", "table": stmt.table})
-            self._engine.truncate_table(stmt.table)
-            self.catalog.invalidate(stmt.table)
-            return _ok()
+            return self._truncate(stmt)
         if isinstance(stmt, ast.ShowCreateStmt):
             td = self.catalog.table_def(stmt.table)
             parts = []
@@ -516,6 +509,36 @@ class Session:
         td.row_count = self._engine.tables[stmt.table] \
             .tablet.row_count_estimate()
         return _ok(rowcount=n)
+
+    def _truncate(self, stmt: ast.TruncateStmt) -> Result:
+        """TRUNCATE TABLE: DDL semantics — implicit commit of the open
+        transaction (MySQL), exclusive table lock so live transactions'
+        redo lands BEFORE the WAL barrier, fresh tablet, counters reset."""
+        if self.db is None:
+            raise NotImplementedError("TRUNCATE needs a Database")
+        td = self.catalog.table_def(stmt.table)  # existence check
+        if self._tx is not None:
+            self._txsvc.commit(self._tx)  # DDL implies COMMIT
+            self._tx = None
+        tx = self._txsvc.begin()
+        try:
+            if self.tenant is not None:
+                # blocks until every live writer of the table finishes,
+                # so their (group-committed) redo precedes the barrier
+                self.tenant.locks.acquire(stmt.table, "X", tx.tx_id,
+                                          timeout=30.0)
+            self._txsvc._log({"op": "truncate", "table": stmt.table})
+            self._engine.truncate_table(stmt.table)
+            # MySQL: TRUNCATE resets AUTO_INCREMENT
+            if self.tenant is not None:
+                for cname in getattr(td, "auto_increment_cols", []):
+                    seq = f"__ai_{stmt.table}_{cname}"
+                    self.tenant.sequences.drop(seq)
+                    self.tenant.sequences.create(seq, start=1)
+        finally:
+            self._txsvc.commit(tx)  # releases the lock
+        self.catalog.invalidate(stmt.table)
+        return _ok()
 
     def _lock_table(self, stmt: ast.LockTableStmt) -> Result:
         """LOCK TABLES t READ|WRITE / UNLOCK TABLES (≙ tablelock as a tx
@@ -876,8 +899,11 @@ class Session:
                 kind = "insert"
                 if replace:
                     # REPLACE INTO: newest version wins over an existing
-                    # row (≙ REPLACE as delete+insert, here one update)
-                    existing = kv.get(key, snapshot=tx.snapshot) \
+                    # row (≙ REPLACE as delete+insert, here one update);
+                    # own-tx writes (incl. earlier rows of this statement)
+                    # count as existing
+                    existing = kv.get(key, snapshot=tx.snapshot,
+                                      tx_id=tx.tx_id) \
                         if kv is not None else None
                     kind = "update" if existing is not None else "insert"
                 self._txsvc.write(tx, stmt.table, tablet, key, kind,

@@ -42,6 +42,50 @@ def test_replace_into(tmp_path):
     db.close()
 
 
+def test_replace_sees_own_statement_and_tx_writes(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    # duplicate key WITHIN one REPLACE statement: last row wins
+    s.execute("replace into t values (1, 1), (1, 2)")
+    assert s.execute("select v from t where k = 1").rows() == [(2,)]
+    # insert-then-replace inside one explicit transaction
+    s.execute("begin")
+    s.execute("insert into t values (7, 70)")
+    s.execute("replace into t values (7, 71)")
+    s.execute("commit")
+    assert s.execute("select v from t where k = 7").rows() == [(71,)]
+    db.close()
+
+
+def test_truncate_with_open_tx_crash_safe(tmp_path):
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("begin")
+    s.execute("insert into t values (5, 5)")
+    s.execute("truncate table t")  # implicit commit, then truncate
+    assert s.execute("select count(*) from t").rows() == [(0,)]
+    db.close()
+    # crash recovery must agree with the live system
+    db2 = Database(root)
+    assert db2.session().execute("select count(*) from t").rows() == [(0,)]
+    db2.close()
+
+
+def test_truncate_resets_auto_increment(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (id int primary key auto_increment, "
+              "v int)")
+    s.execute("insert into t (v) values (1), (2), (3)")
+    s.execute("truncate table t")
+    s.execute("insert into t (v) values (9)")
+    assert s.execute("select id from t").rows() == [(1,)]
+    db.close()
+
+
 def test_show_create_table(tmp_path):
     db = Database(str(tmp_path / "db"))
     s = db.session()
