@@ -126,6 +126,7 @@ class CreateTableStmt:
     if_not_exists: bool = False
     # PARTITION BY RANGE(col): (col, [upper-exclusive bounds]) or None
     partition: tuple | None = None
+    as_select: object = None  # CREATE TABLE ... AS SELECT
 
 
 @dataclass

@@ -884,6 +884,11 @@ class Parser:
             self.expect_kw("exists")
             if_not_exists = True
         name = self.expect_ident()
+        if self.accept_kw("as"):
+            sel = self.parse_select()
+            stmt = ast.CreateTableStmt(name, [], [], if_not_exists)
+            stmt.as_select = sel
+            return stmt
         self.expect_op("(")
         cols = []
         pk: list[str] = []

@@ -766,6 +766,8 @@ class Session:
     # DDL / DML (storage-engine integration deepens in storage/ + tx/)
     # ------------------------------------------------------------------
     def _create_table(self, stmt: ast.CreateTableStmt) -> Result:
+        if getattr(stmt, "as_select", None) is not None:
+            return self._create_table_as(stmt)
         cols = [ColumnDef(c.name, c.dtype, c.nullable) for c in stmt.columns]
         auto_cols = [c.name for c in stmt.columns
                      if getattr(c, "auto_increment", False)]
@@ -1068,6 +1070,36 @@ class Session:
     # ------------------------------------------------------------------
     # legacy host-side DML (catalog without a storage engine)
     # ------------------------------------------------------------------
+    def _create_table_as(self, stmt: ast.CreateTableStmt) -> Result:
+        """CREATE TABLE AS SELECT: schema inferred from the result set,
+        rows direct-loaded (≙ CTAS via the direct-load path)."""
+        if self.db is None:
+            raise NotImplementedError("CTAS needs a Database")
+        res = self._execute_select(stmt.as_select, None)
+        cols = [ColumnDef(name, res.dtypes.get(name, SqlType.int_()))
+                for name in res.names]
+        tdef = TableDef(stmt.name, cols)
+        self.catalog.create_table(tdef, if_not_exists=stmt.if_not_exists)
+        arrays, valids = {}, {}
+        for name in res.names:
+            arr = res.arrays[name]
+            t = res.dtypes.get(name)
+            if t is not None and t.is_string:
+                # NULL lanes carry None payloads; validity is authoritative
+                arrays[name] = np.array(
+                    [x if x is not None else "" for x in arr], dtype=object)
+            else:
+                arrays[name] = arr
+            v = res.valids.get(name)
+            if v is not None and not v.all():
+                valids[name] = v
+        if res.rowcount:
+            self._engine.bulk_load(stmt.name, arrays, valids or None,
+                                   version=self._txsvc.gts.get_ts())
+        self.catalog.invalidate(stmt.name)
+        tdef.row_count = res.rowcount
+        return _ok(rowcount=res.rowcount)
+
     def _insert(self, stmt: ast.InsertStmt, params) -> Result:
         if self.db is not None:
             return self._insert_tx(stmt, params)

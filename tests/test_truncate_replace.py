@@ -86,6 +86,25 @@ def test_truncate_resets_auto_increment(tmp_path):
     db.close()
 
 
+def test_create_table_as_select(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table src (k int primary key, v decimal(10,2), "
+              "name varchar(20))")
+    s.execute("insert into src values (1, 1.50, 'a'), (2, 2.25, 'b'), "
+              "(3, 3.00, null)")
+    r = s.execute("create table dst as select k, v * 2 as v2, name "
+                  "from src where k >= 2")
+    assert r.rowcount == 2
+    rows = s.execute("select k, v2, name from dst order by k").rows()
+    assert rows == [(2, 4.5, "b"), (3, 6.0, None)]
+    # CTAS over aggregates
+    s.execute("create table agg as select name, count(*) as n from src "
+              "group by name")
+    assert s.execute("select sum(n) from agg").rows() == [(3,)]
+    db.close()
+
+
 def test_show_create_table(tmp_path):
     db = Database(str(tmp_path / "db"))
     s = db.session()
