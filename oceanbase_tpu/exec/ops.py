@@ -643,15 +643,17 @@ def join(
     lkey_p = jnp.where(lvalid, lkey, BIG - 1)
     lo = jnp.searchsorted(rkey_sorted, lkey_p, side="left")
     hi = jnp.searchsorted(rkey_sorted, lkey_p, side="right")
+    # lo/hi ∈ [0, rn] so counts <= rn always — no clamp needed
     counts = jnp.where(lvalid, hi - lo, 0)
-    counts = jnp.minimum(counts, rn)  # safety clamp
 
-    if how == "semi":
+    if exact and how == "semi":
         return left.with_mask(lm & (counts > 0))
-    if how == "anti":
+    if exact and how == "anti":
         # NOT EXISTS semantics: NULL keys never match, so they survive.
         # (NOT IN adds null-poisoning on top; the planner layers that.)
         return left.with_mask(lm & (counts == 0))
+    # inexact (hash-combined) semi/anti fall through: candidate counts
+    # include hash collisions, so matches must be verified by expansion
 
     keep_unmatched = how == "left"
     if keep_unmatched:
@@ -690,13 +692,25 @@ def join(
             lg = jnp.take(lc.data, probe_idx)
             rg = jnp.take(rc.data, build_idx)
             ok = ok & (lg == rg)
+        true_lane = out_live & matched & ok
+        # true-match re-count per probe row: collisions must neither emit
+        # phantom NULL-extended rows nor satisfy semi/anti membership
+        tc = jax.ops.segment_sum(true_lane.astype(jnp.int64), probe_idx,
+                                 num_segments=ln)
+        if how == "semi":
+            return left.with_mask(lm & (tc > 0))
+        if how == "anti":
+            return left.with_mask(lm & (tc == 0))
         if how == "left":
-            # collision row: treat as unmatched only if no true match exists;
-            # rare — round-1 approximation keeps the row with build cols nulled
+            # a lane survives as a real match, or as the single
+            # NULL-extended row when its probe row has no true match
+            tc_g = jnp.take(tc, probe_idx)
+            null_lane = (off == 0) & (tc_g == 0)
+            live = out_live & (true_lane | null_lane)
             for name in right.columns:
                 c = out_cols[name]
                 out_cols[name] = Column(c.data,
-                                        c.valid_or_true() & ok & matched,
+                                        c.valid_or_true() & true_lane,
                                         c.dtype, c.sdict)
         else:
             live = live & ok

@@ -30,6 +30,11 @@ class KvTable:
         full = {c: values.get(c) for c in tablet.columns
                 if c != "__rowid__"}
         key = tablet.make_key(dict(values))
+        # copy allocated key columns (hidden rowids) back into the stored
+        # row — otherwise every keyless put persists a NULL rowid and
+        # newest-wins dedup collapses all rows into one
+        for kc, kv in zip(tablet.key_cols, key):
+            full[kc] = kv
         svc = self.tenant.tx
         own = tx is None
         if own:

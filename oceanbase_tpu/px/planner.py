@@ -49,7 +49,17 @@ from oceanbase_tpu.px.exchange import (
 )
 from oceanbase_tpu.vector.column import Relation
 
-BROADCAST_THRESHOLD = 1 << 16  # rows; below this, build sides broadcast
+BROADCAST_THRESHOLD_BYTES = 4 << 20  # build sides smaller than this replicate
+
+
+def _row_bytes(rel) -> int:
+    """Estimated bytes per row of a lowered Relation (data + null bitmap);
+    the broadcast decision is bytes-based, not rows-based (a 65k-row wide
+    build side must not replicate just because its row count is small)."""
+    b = 0
+    for c in rel.columns.values():
+        b += c.data.dtype.itemsize + (1 if c.valid is not None else 0)
+    return max(b, 1)
 
 _DIST_OK = (pp.TableScan, pp.Filter, pp.Project, pp.GroupBy,
             pp.HashJoin, pp.SemiJoinResidual, pp.Union, pp.Compact)
@@ -153,7 +163,8 @@ def _dlower(node: pp.PlanNode, tables: dict, ndev: int, axis: str,
 
 
 def _djoin(left, right, lkeys, rkeys, how, cap, ndev, axis, factor=1):
-    if right.capacity <= BROADCAST_THRESHOLD or not lkeys:
+    if right.capacity * _row_bytes(right) <= BROADCAST_THRESHOLD_BYTES \
+            or not lkeys:
         # small or keyless build side: replicate it (BROADCAST dist)
         bright = broadcast_gather(right, axis)
         return ops.join(left, bright, lkeys, rkeys, how=how,

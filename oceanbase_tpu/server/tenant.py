@@ -45,6 +45,13 @@ class Tenant:
                 ldr.entries[start:ldr.committed_lsn], self.engine)
             self.tx.gts.advance_to(max_ts)
         self.tx.gts.advance_to(self.engine.meta.get("gts", 0))
+        # bulk_load (CTAS / LOAD DATA / direct load) stamps segments with
+        # GTS values that reach neither the WAL nor (pre-checkpoint) the
+        # persisted meta — seed GTS past every persisted segment version
+        # so the boot snapshot sees them
+        self.tx.gts.advance_to(max(
+            (s.max_version for ts in self.engine.tables.values()
+             for s, _ in ts.tablet.segment_locations()), default=0))
 
         self.catalog = StorageCatalog(self.engine,
                                       snapshot_fn=self.tx.gts.current)
@@ -93,12 +100,18 @@ class Tenant:
         return self._pool.submit(fn, *args, **kwargs)
 
     def checkpoint(self):
+        # capture the replay point BEFORE the flush snapshot: commit()
+        # assigns the version before appending to the WAL, so every
+        # commit at or below this LSN has version <= snap and is covered
+        # by the flushed segments (a commit landing between the two reads
+        # has LSN > wal_lsn and is replayed on recovery)
+        wal_lsn = self.wal.committed_lsn()
         snap = self.tx.gts.current()
         for name in list(self.engine.tables):
             self.engine.freeze_and_flush(name, snapshot=snap)
         # group commit means live transactions have nothing in the WAL, so
         # the committed LSN is always a safe replay point
-        self.engine.meta["wal_lsn"] = self.wal.committed_lsn()
+        self.engine.meta["wal_lsn"] = wal_lsn
         self.engine.meta["gts"] = self.tx.gts.current()
         self.engine.checkpoint()
 

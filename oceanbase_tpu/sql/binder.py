@@ -641,8 +641,12 @@ class Binder:
                 anti=anti, out_capacity=cap,
             )
         else:
+            # explicit capacity: inexact (multi-key) semi/anti joins expand
+            # candidate pairs for collision verification, and only a
+            # non-None out_capacity is reachable by scale_capacities on
+            # CapacityOverflow retries
             new_plan = pp.HashJoin(f.plan, in_plan, lhs_exprs, rkeys,
-                                   how=how, out_capacity=None)
+                                   how=how, out_capacity=cap)
         est = max(1, f.est_rows // (2 if not anti else 3))
         qb.fragments[i] = Fragment(new_plan, f.cols, est, f.unique_cols)
 

@@ -196,7 +196,10 @@ class Parser:
     def parse_select(self) -> ast.SelectStmt:
         ctes = []
         if self.accept_kw("with"):
-            self.accept_kw("recursive")
+            if self.accept_kw("recursive"):
+                # ≙ src/sql/engine/recursive_cte — not implemented here;
+                # fail loudly instead of mis-resolving the recursive ref
+                raise ParseError("WITH RECURSIVE is not supported")
             while True:
                 name = self.expect_ident()
                 self.expect_kw("as")

@@ -527,8 +527,8 @@ class Session:
                 # so their (group-committed) redo precedes the barrier
                 self.tenant.locks.acquire(stmt.table, "X", tx.tx_id,
                                           timeout=30.0)
-            self._txsvc._log({"op": "truncate", "table": stmt.table})
-            self._engine.truncate_table(stmt.table)
+            lsn = self._txsvc._log({"op": "truncate", "table": stmt.table})
+            self._engine.truncate_table(stmt.table, wal_lsn=lsn)
             # MySQL: TRUNCATE resets AUTO_INCREMENT
             if self.tenant is not None:
                 for cname in getattr(td, "auto_increment_cols", []):

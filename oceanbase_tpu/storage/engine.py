@@ -43,6 +43,11 @@ class StorageEngine:
         self.root = root
         self.tables: dict[str, TableStore] = {}
         self.meta: dict = {}  # checkpointed runtime meta (wal replay point…)
+        # table -> WAL LSN of the newest TRUNCATE whose slog record this
+        # engine has already applied; WAL replay must not re-apply
+        # truncate barriers at/below these (they would drop direct-load
+        # segments the slog restored AFTER the truncate)
+        self.truncate_barriers: dict[str, int] = {}
         self._lock = threading.RLock()
         self._slog_f = None
         if root is not None:
@@ -144,6 +149,9 @@ class StorageEngine:
         elif kind == "truncate":
             if op["table"] in self.tables:
                 self.truncate_table(op["table"], log=False)
+            self.truncate_barriers[op["table"]] = max(
+                self.truncate_barriers.get(op["table"], 0),
+                op.get("wal_lsn", 0))
         elif kind == "alter_add":
             n, k, p, s, nl = op["column"]
             if op["table"] in self.tables:
@@ -215,6 +223,14 @@ class StorageEngine:
         with self._lock:
             if tdef.name in self.tables:
                 raise ValueError(f"table {tdef.name} exists")
+            if tdef.partition is not None and tdef.primary_key and \
+                    tdef.partition[0] not in tdef.primary_key:
+                # MySQL/OceanBase rule: every unique key (incl. the PK)
+                # must contain all partitioning columns — otherwise
+                # uniqueness could only be checked across partitions
+                raise ValueError(
+                    "a PRIMARY KEY must include all columns in the "
+                    "table's partitioning function")
             self._install_table(tdef)
 
     def alter_table(self, name: str, action: str, column, log=True):
@@ -294,17 +310,41 @@ class StorageEngine:
             for t in tablets:
                 t.data_version += 1
 
-    def truncate_table(self, name: str, log=True):
+    def truncate_table(self, name: str, log=True, wal_lsn: int = 0):
         """Drop all data, keep the schema: reinstall a fresh tablet
-        (segments unlinked; ≙ TRUNCATE as fast DDL, not row deletes)."""
+        (segments unlinked; ≙ TRUNCATE as fast DDL, not row deletes).
+
+        ``wal_lsn`` is the LSN of the matching WAL truncate record; it is
+        persisted in the slog record so recovery can fence WAL replay
+        against engine state (the two logs share one order)."""
         with self._lock:
             ts = self.tables[name]
             tdef = ts.tdef
             del self.tables[name]
             self._install_table(tdef, log=False)
             self.tables[name].tdef.row_count = 0
+            if wal_lsn:
+                self.truncate_barriers[name] = max(
+                    self.truncate_barriers.get(name, 0), wal_lsn)
             if log:
-                self._log_meta({"op": "truncate", "table": name})
+                self._log_meta({"op": "truncate", "table": name,
+                                "wal_lsn": wal_lsn})
+
+    def reset_memtables(self, name: str):
+        """Discard memtable state only, keeping segments — used by WAL
+        replay when a TRUNCATE barrier was already applied via the slog
+        (the slog-restored post-truncate segments must survive)."""
+        from oceanbase_tpu.storage.memtable import MemTable
+
+        with self._lock:
+            ts = self.tables.get(name)
+            if ts is None:
+                return
+            tab = ts.tablet
+            for t in getattr(tab, "partitions", [tab]):
+                t.active = MemTable(next(t._next_mt))
+                t.frozen = []
+                t.data_version += 1
 
     def drop_table(self, name: str):
         with self._lock:
@@ -485,7 +525,8 @@ class StorageCatalog(Catalog):
             hit = self._cache.get(name)
             if hit is not None and hit[0] == ver:
                 return hit[1]
-            arrays, valids = ts.tablet.snapshot_arrays(self.snapshot_fn())
+            snap = self.snapshot_fn()
+            arrays, valids = ts.tablet.snapshot_arrays(snap)
             n = len(next(iter(arrays.values()))) if arrays else 0
             if n == 0:
                 # static shapes need capacity >= 1: one all-dead row
@@ -496,7 +537,14 @@ class StorageCatalog(Catalog):
                     types={c.name: c.dtype for c in ts.tdef.columns},
                     valids={k: v for k, v in valids.items() if v is not None},
                 )
-            self._cache[name] = (ver, rel)
+            # only cache snapshots that cover every persisted segment —
+            # a snapshot below a segment's max_version would pin a
+            # partial view that later (larger) snapshots must not reuse
+            seg_max = max((s.max_version
+                           for s, _ in ts.tablet.segment_locations()),
+                          default=0)
+            if snap >= seg_max:
+                self._cache[name] = (ver, rel)
             ts.tdef.row_count = rel.capacity
             return rel
 

@@ -71,6 +71,14 @@ class Tablet:
     def write(self, key: tuple, op: str, values: dict, tx_id: int,
               stmt_seq: int = 0, snapshot: int | None = None):
         with self._lock:
+            # invariant: stored values always carry their key columns
+            # (callers that copied the dict before make_key would
+            # otherwise persist NULL rowids that dedup collapses)
+            if any(values.get(kc) is None for kc in self.key_cols):
+                values = dict(values)
+                for kc, kv in zip(self.key_cols, key):
+                    if values.get(kc) is None:
+                        values[kc] = kv
             # SI conflict checks look at frozen memtables too: the key's
             # newest version may have been frozen mid-transaction
             if snapshot is not None:

@@ -251,8 +251,18 @@ class TransService:
             elif op == "truncate":
                 # replayed in log order: discard everything replayed into
                 # the table so far (≙ TRUNCATE barrier in the redo stream)
-                if rec["table"] in engine.tables:
-                    engine.truncate_table(rec["table"], log=False)
+                table = rec["table"]
+                if e.lsn <= engine.truncate_barriers.get(table, 0):
+                    # the slog already applied this truncate AND restored
+                    # post-truncate direct-load segments; only clear what
+                    # WAL replay itself put into the memtables
+                    engine.reset_memtables(table)
+                elif table in engine.tables:
+                    engine.truncate_table(table, log=False)
+                # drop buffered redo of the table (writers finish before
+                # the barrier thanks to the X table lock; belt-and-braces)
+                for recs in pending.values():
+                    recs[:] = [r for r in recs if r["table"] != table]
         return max_ts
 
 

@@ -1,0 +1,143 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md r2).
+
+1. WAL truncate barrier vs slog-restored direct-load segments
+2. GTS seeding below bulk_load segment versions after restart
+3. partition column must be part of the primary key
+4. checkpoint racing a concurrent commit (lost on crash)
+5. keyless KvTable.put losing hidden rowids
+"""
+
+import pytest
+
+from oceanbase_tpu.server import Database
+
+
+def test_truncate_then_load_data_survives_restart(tmp_path):
+    """ADVICE #1: TRUNCATE writes a WAL barrier; LOAD DATA writes only
+    slog (add_segment). On recovery the WAL truncate replay must not drop
+    the slog-restored post-truncate segments."""
+    csv = tmp_path / "rows.csv"
+    csv.write_text("5,50\n6,60\n")
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 1), (2, 2)")
+    s.execute("truncate table t")
+    s.execute(f"load data infile '{csv}' into table t "
+              f"fields terminated by ','")
+    assert sorted(s.execute("select k from t").rows()) == [(5,), (6,)]
+    db.close()
+    db2 = Database(root)
+    assert sorted(db2.session().execute("select k from t").rows()) == \
+        [(5,), (6,)]
+    db2.close()
+
+
+def test_truncate_replay_still_drops_pre_truncate_rows(tmp_path):
+    """The fence must not resurrect pre-truncate WAL rows either."""
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 1), (2, 2)")
+    s.execute("truncate table t")
+    s.execute("insert into t values (9, 9)")
+    db.close()
+    db2 = Database(root)
+    assert sorted(db2.session().execute("select k from t").rows()) == [(9,)]
+    db2.close()
+
+
+def test_ctas_visible_after_restart(tmp_path):
+    """ADVICE #2: CTAS stamps segments with GTS values that reach neither
+    the WAL nor pre-checkpoint meta; boot must seed GTS past them."""
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table src (k int primary key, v int)")
+    s.execute("insert into src values (1, 10), (2, 20)")
+    s.execute("create table dst as select * from src")
+    db.close()
+    db2 = Database(root)
+    s2 = db2.session()
+    assert sorted(s2.execute("select k, v from dst").rows()) == \
+        [(1, 10), (2, 20)]
+    # and repeatedly (the relation cache must not pin an empty view)
+    assert sorted(s2.execute("select k, v from dst").rows()) == \
+        [(1, 10), (2, 20)]
+    db2.close()
+
+
+def test_partition_column_must_be_in_primary_key(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    with pytest.raises(Exception, match="[Pp]artition"):
+        s.execute(
+            "create table p (k int primary key, v int) "
+            "partition by range (v) ("
+            "partition p0 values less than (100), "
+            "partition p1 values less than maxvalue)")
+    # keyless tables carry no uniqueness constraint: any column is fine
+    s.execute(
+        "create table q (a int, b int) partition by range (b) ("
+        "partition p0 values less than (100), "
+        "partition p1 values less than maxvalue)")
+    s.execute("insert into q values (1, 10), (1, 200)")
+    assert sorted(s.execute("select a, b from q").rows()) == \
+        [(1, 10), (1, 200)]
+    db.close()
+
+
+def test_checkpoint_concurrent_commit_not_lost(tmp_path):
+    """ADVICE #4: a commit landing between the flush snapshot and the
+    recorded WAL replay point must survive a crash. The fix records the
+    replay point BEFORE the snapshot; inject a commit mid-checkpoint."""
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 1)")
+    tenant = db.tenant()
+    orig = tenant.engine.freeze_and_flush
+    injected = {"done": False}
+
+    def inject_then_flush(name, snapshot):
+        if not injected["done"]:
+            injected["done"] = True
+            db.session().execute("insert into t values (2, 2)")
+        return orig(name, snapshot=snapshot)
+
+    tenant.engine.freeze_and_flush = inject_then_flush
+    try:
+        db.checkpoint()
+    finally:
+        tenant.engine.freeze_and_flush = orig
+    db.close()
+    db2 = Database(root)
+    assert sorted(db2.session().execute("select k from t").rows()) == \
+        [(1,), (2,)]
+    db2.close()
+
+
+def test_kv_keyless_puts_persist_rowids(tmp_path):
+    """ADVICE #5: puts on a __rowid__ table must persist distinct rowids
+    (newest-wins dedup collapsed them all into one row)."""
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table logs (msg varchar(32), n int)")  # keyless
+    kv = db.tenant().kv("logs")
+    kv.put({"msg": "a", "n": 1})
+    kv.put({"msg": "b", "n": 2})
+    rows = kv.scan()
+    assert sorted((r["msg"], r["n"]) for r in rows) == [("a", 1), ("b", 2)]
+    assert sorted(s.execute("select msg, n from logs").rows()) == \
+        [("a", 1), ("b", 2)]
+    # rowids survive flush + restart
+    db.checkpoint()
+    db.close()
+    db2 = Database(root)
+    assert sorted(db2.session().execute("select msg, n from logs")
+                  .rows()) == [("a", 1), ("b", 2)]
+    db2.close()

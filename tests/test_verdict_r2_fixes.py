@@ -1,0 +1,94 @@
+"""Regression tests for round-1 VERDICT correctness traps.
+
+- multi-key (hash-combined) joins: left-join phantom NULL rows,
+  semi/anti collision verification
+- WITH RECURSIVE must be rejected loudly
+- broadcast decision is bytes-based
+"""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.exec import ops
+from oceanbase_tpu.expr import ir
+from oceanbase_tpu.sql.parser import ParseError, Parser
+from oceanbase_tpu.vector import from_numpy
+
+
+def _rels(lrows, rrows):
+    left = from_numpy({"a": np.array([r[0] for r in lrows]),
+                       "b": np.array([r[1] for r in lrows])})
+    right = from_numpy({"x": np.array([r[0] for r in rrows]),
+                        "y": np.array([r[1] for r in rrows]),
+                        "v": np.array([r[2] for r in rrows])})
+    keys_l = [ir.ColumnRef("a"), ir.ColumnRef("b")]
+    keys_r = [ir.ColumnRef("x"), ir.ColumnRef("y")]
+    return left, right, keys_l, keys_r
+
+
+def _result_rows(rel, cols):
+    import jax.numpy as jnp
+
+    mask = np.asarray(rel.mask_or_true())
+    out = []
+    for i in np.nonzero(mask)[0]:
+        row = []
+        for c in cols:
+            col = rel.columns[c]
+            valid = col.valid is None or bool(np.asarray(col.valid)[i])
+            row.append(np.asarray(col.data)[i].item() if valid else None)
+        out.append(tuple(row))
+    return sorted(out)
+
+
+def test_multikey_left_join_no_phantom_rows():
+    # 2-key join goes through the hash-combined (inexact) path
+    left, right, kl, kr = _rels(
+        [(1, 1), (2, 2), (3, 3)],
+        [(1, 1, 10), (1, 1, 11), (9, 9, 99)])
+    out = ops.join(left, right, kl, kr, how="left", out_capacity=16)
+    rows = _result_rows(out, ["a", "v"])
+    # (1,1) matches twice; (2,2),(3,3) get exactly ONE null-extended row
+    assert rows == [(1, 10), (1, 11), (2, None), (3, None)]
+
+
+def test_multikey_semi_anti_verified():
+    left, right, kl, kr = _rels(
+        [(1, 1), (2, 2)],
+        [(1, 1, 10), (5, 5, 50)])
+    semi = ops.join(left, right, kl, kr, how="semi")
+    assert _result_rows(semi, ["a"]) == [(1,)]
+    anti = ops.join(left, right, kl, kr, how="anti")
+    assert _result_rows(anti, ["a"]) == [(2,)]
+
+
+def test_multikey_left_join_engineered_collision():
+    """Force a false-positive candidate range: many build rows, probe row
+    whose keys match none. The output must contain exactly one
+    NULL-extended row for it, not one per candidate."""
+    n = 64
+    left, right, kl, kr = _rels(
+        [(999, 999)],
+        [(i, i, i) for i in range(n)])
+    out = ops.join(left, right, kl, kr, how="left", out_capacity=128)
+    rows = _result_rows(out, ["a", "v"])
+    assert rows == [(999, None)]
+
+
+def test_with_recursive_rejected():
+    with pytest.raises(ParseError, match="RECURSIVE"):
+        Parser("with recursive r as (select 1) select * from r").parse()
+    # plain WITH still works
+    Parser("with r as (select 1 as x) select x from r").parse()
+
+
+def test_broadcast_threshold_is_bytes():
+    from oceanbase_tpu.px import planner
+
+    wide = from_numpy({f"c{i}": np.zeros(1 << 12, dtype=np.int64)
+                       for i in range(200)})
+    narrow = from_numpy({"c": np.zeros(1 << 12, dtype=np.int64)})
+    assert narrow.capacity * planner._row_bytes(narrow) \
+        <= planner.BROADCAST_THRESHOLD_BYTES
+    assert wide.capacity * planner._row_bytes(wide) \
+        > planner.BROADCAST_THRESHOLD_BYTES
