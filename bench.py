@@ -55,6 +55,99 @@ def numpy_q6(li, d0, d1):
     return (li["l_extendedprice"][sel] * li["l_discount"][sel]).sum()
 
 
+def _relay_floor_s(jax):
+    """Round-trip latency of a trivial dispatch + scalar readback.
+
+    Under the axon loopback relay a single dispatch costs ~30-70ms of RPC
+    latency and ``block_until_ready`` returns at dispatch, not completion —
+    so device timing must (a) force a host readback to synchronize and
+    (b) amortize many iterations inside ONE compiled program, subtracting
+    this floor."""
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.int32(0)
+    float(f(x))
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(f(x))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def _perturbed(tables, delta):
+    """Add a runtime scalar (0 in practice) to every numeric column so a
+    fori_loop over ``delta*i`` cannot be hoisted by XLA."""
+    import jax.numpy as jnp
+
+    out = {}
+    for tname, r in tables.items():
+        cols = {}
+        for cname, col in r.columns.items():
+            if jnp.issubdtype(col.data.dtype, jnp.bool_):
+                cols[cname] = col
+            else:
+                cols[cname] = col.with_data(
+                    col.data + delta.astype(col.data.dtype))
+        out[tname] = type(r)(columns=cols, mask=r.mask)
+    return out
+
+
+def _checksum(rel):
+    import jax.numpy as jnp
+
+    acc = jnp.float32(0)
+    for col in rel.columns.values():
+        acc = acc + jnp.sum(col.data.astype(jnp.float32))
+    if rel.mask is not None:
+        acc = acc + jnp.sum(rel.mask.astype(jnp.float32))
+    return acc
+
+
+def _timed_device_loop(jax, make_loop, min_total_s=1.0):
+    """make_loop(k) -> compiled fn(salt) returning a scalar for k
+    in-program iterations.  ``salt`` MUST be a traced argument (0 at
+    runtime): a closed-over jnp constant would let XLA fold the
+    perturbation away and hoist the loop body into a single computation.
+
+    Returns (per_iter_s, k_used, floor_s). Two compiles: a pilot k=8 run
+    estimates per-iter cost, then one right-sized run produces the number."""
+    import jax.numpy as jnp
+
+    floor = _relay_floor_s(jax)
+    salt = jnp.int32(0)
+    pilot_k = 8
+    f = make_loop(pilot_k)
+    t0 = time.perf_counter()
+    float(f(salt))  # compile + warm
+    print(f"# pilot k={pilot_k} compile+run: {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr)
+    t0 = time.perf_counter()
+    float(f(salt))
+    total = time.perf_counter() - t0
+    per = max(total - floor, 1e-7) / pilot_k
+    k = int(min(4096, max(pilot_k, min_total_s / per)))
+    if k > pilot_k * 2:
+        f = make_loop(k)
+        t0 = time.perf_counter()
+        float(f(salt))
+        print(f"# sized k={k} compile+run: {time.perf_counter()-t0:.1f}s",
+              file=sys.stderr)
+        t0 = time.perf_counter()
+        float(f(salt))
+        total = time.perf_counter() - t0
+    else:
+        k = pilot_k
+    best = max(total - floor, 1e-7) / k
+    for _ in range(2):
+        t0 = time.perf_counter()
+        float(f(salt))
+        total = time.perf_counter() - t0
+        best = min(best, max(total - floor, 1e-7) / k)
+    return best, k, floor
+
+
 def _ensure_backend():
     """The axon TPU tunnel can be unavailable; rather than hang or crash,
     re-exec on CPU (the JSON line carries `platform` so the fallback is
@@ -134,21 +227,23 @@ def main():
         qty = jnp.asarray(li["l_quantity"].astype(np.int32))
         price = jnp.asarray(li["l_extendedprice"].astype(np.int32))
         live = jnp.ones(n_rows, dtype=jnp.int32)
-        t0 = time.time()
-        out_v = jax.block_until_ready(
-            q6_filter_sum(ship, disc, qty, price, live, **args))
-        print(f"# pallas compile+first-run: {time.time()-t0:.1f}s",
-              file=sys.stderr)
-        times = []
-        for _ in range(iters):
-            t0 = time.time()
-            out_v = jax.block_until_ready(
-                q6_filter_sum(ship, disc, qty, price, live, **args))
-            times.append(time.time() - t0)
-        dev_time = min(times)
+
+        out_v = q6_filter_sum(ship, disc, qty, price, live, **args)
         oracle = numpy_q6(li, date_to_days("1994-01-01"),
                           date_to_days("1995-01-01"))
         assert int(out_v) == int(oracle), "pallas Q6 mismatch"
+
+        def make_loop(k):
+            def loop(salt):
+                def body(i, acc):
+                    d = salt * i
+                    return acc + q6_filter_sum(
+                        ship + d, disc + d, qty + d, price + d, live,
+                        **args).astype(jnp.float32)
+                return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+            return jax.jit(loop)
+
+        dev_time, k_used, floor = _timed_device_loop(jax, make_loop)
         which = "q6_pallas"
         out = None
     elif mode == "stream":
@@ -160,35 +255,50 @@ def main():
         chunk = int(os.environ.get("BENCH_CHUNK_ROWS", 1 << 21))
         provider = numpy_chunk_provider(arrays)
         cache = {}
+
+        def run_stream():
+            r = execute_streamed(
+                plan, provider, chunk_rows=chunk, types=btypes, cache=cache)
+            float(_checksum(r))  # true sync: scalar readback
+            return r
+
         t0 = time.time()
-        out = jax.block_until_ready(execute_streamed(
-            plan, provider, chunk_rows=chunk, types=btypes, cache=cache))
+        out = run_stream()
         print(f"# stream compile+dict-pass+first-run: {time.time()-t0:.1f}s",
               file=sys.stderr)
         times = []
         for _ in range(iters):
             t0 = time.time()
-            out = jax.block_until_ready(execute_streamed(
-                plan, provider, chunk_rows=chunk, types=btypes, cache=cache))
+            out = run_stream()
             times.append(time.time() - t0)
-        dev_time = min(times)
+        # streaming is inherently multi-dispatch (host chunk feed); report
+        # end-to-end including per-chunk dispatch latency, minus one floor
+        dev_time = max(min(times) - _relay_floor_s(jax), 1e-7)
         which = which + "_stream"
     else:
+        import jax.numpy as jnp
+
         rel = from_numpy(arrays, types=btypes)
         dev_tables = {"lineitem": rel}
 
         run = jax.jit(lambda t: _lower(plan, t))
         t0 = time.time()
-        out = jax.block_until_ready(run(dev_tables))
+        out = run(dev_tables)
+        float(_checksum(out))  # sync
         compile_s = time.time() - t0
         print(f"# compile+first-run: {compile_s:.1f}s", file=sys.stderr)
 
-        times = []
-        for _ in range(iters):
-            t0 = time.time()
-            out = jax.block_until_ready(run(dev_tables))
-            times.append(time.time() - t0)
-        dev_time = min(times)
+        def make_loop(k):
+            def loop_t(tabs, salt):
+                def body(i, acc):
+                    t2 = _perturbed(tabs, salt * i)
+                    return acc + _checksum(_lower(plan, t2))
+                return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+            jf = jax.jit(loop_t)
+            return lambda salt: jf(dev_tables, salt)
+
+
+        dev_time, k_used, floor = _timed_device_loop(jax, make_loop)
 
     # host numpy baseline
     cutoff = date_to_days("1998-09-02")
@@ -208,16 +318,22 @@ def main():
 
     rows_per_sec = n_rows / dev_time
     platform = jax.devices()[0].platform
-    print(json.dumps({
+    rec = {
         "metric": f"tpch_{which}_sf{sf:g}_rows_per_sec_chip",
         "value": round(rows_per_sec, 1),
         "unit": "rows/s",
         "vs_baseline": round(cpu_time / dev_time, 3),
-        "device_time_s": round(dev_time, 4),
+        "device_time_s": round(dev_time, 6),
         "numpy_cpu_time_s": round(cpu_time, 4),
         "rows": n_rows,
         "platform": platform,
-    }))
+    }
+    try:
+        rec["loop_iters"] = k_used
+        rec["relay_floor_ms"] = round(floor * 1e3, 2)
+    except NameError:
+        pass  # stream mode times end-to-end, no in-program loop
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

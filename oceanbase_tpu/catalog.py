@@ -27,6 +27,23 @@ class ColumnDef:
 
 
 @dataclass
+class IndexDef:
+    """A secondary index (≙ index-table schema, ObTableSchema with
+    INDEX_TYPE_NORMAL/UNIQUE — src/share/schema/ob_table_schema.h).
+
+    Stored as its own index TABLE whose key is (index columns + primary
+    key columns) — the index-table model OceanBase uses, riding the same
+    tablet/WAL/MVCC machinery as any table.  ``storage_table`` names it.
+    """
+
+    name: str
+    table: str
+    columns: list[str]
+    unique: bool
+    storage_table: str
+
+
+@dataclass
 class TableDef:
     name: str
     columns: list[ColumnDef]
@@ -37,6 +54,7 @@ class TableDef:
     # range partitioning: (column, [upper-exclusive split points]) or None
     partition: tuple | None = None
     auto_increment_cols: list = field(default_factory=list)
+    indexes: list = field(default_factory=list)  # list[IndexDef]
 
     def column(self, name: str) -> ColumnDef:
         for c in self.columns:
@@ -167,4 +185,7 @@ class Catalog:
 
     def tables(self) -> list[str]:
         with self._lock:
-            return sorted(self._defs)
+            # index storage tables are internal (reachable by name, but
+            # hidden from SHOW TABLES / information_schema enumeration)
+            return sorted(n for n in self._defs
+                          if not n.startswith("__idx__"))

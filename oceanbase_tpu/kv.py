@@ -55,60 +55,21 @@ class KvTable:
 
     def get(self, key, columns: Optional[list] = None,
             snapshot: int | None = None, tx_id: int = 0) -> Optional[dict]:
-        """Point lookup: memtables newest-first, then segments newest-first
-        (≙ table api GET riding the LSM read path).  ``tx_id`` makes the
+        """Point lookup riding the index-aware LSM read path
+        (storage/lookup.py): memtables newest-first, then key-sorted
+        segments with zone-map chunk pruning — O(chunks-holding-key)
+        decode, not a whole-segment scan.  ``tx_id`` makes the
         transaction's own uncommitted writes visible."""
+        from oceanbase_tpu.storage.lookup import point_lookup
+
         tablet = self.ts.tablet
         key = self._key_of(key)
         snap = snapshot if snapshot is not None else \
             self.tenant.tx.gts.current()
-        for mt in tablet.memtables():
-            v = mt.visible_version(key, snap, tx_id)
-            if v is not None:
-                if v.op == "delete":
-                    return None
-                row = dict(v.values)
-                return {c: row.get(c) for c in (columns or row)}
-        # segments newest-first; rows within carry __version__/__deleted__
-        best = None
-        best_ver = -1
-        for seg in tablet.segments[::-1]:
-            if seg.min_version > snap:
-                continue
-            arrays, valids = seg.decode()
-            import numpy as np
-
-            n = len(next(iter(arrays.values()))) if arrays else 0
-            if n == 0:
-                continue
-            sel = np.ones(n, dtype=bool)
-            for kc, kv in zip(tablet.key_cols, key):
-                sel &= arrays[kc] == kv
-            if "__version__" in arrays:
-                sel &= arrays["__version__"] <= snap
-            idx = np.nonzero(sel)[0]
-            if len(idx) == 0:
-                continue
-            vers = arrays.get("__version__")
-            i = idx[-1] if vers is None else idx[np.argmax(vers[idx])]
-            ver = int(vers[i]) if vers is not None else seg.max_version
-            if ver > best_ver:
-                best_ver = ver
-                if arrays.get("__deleted__") is not None and \
-                        arrays["__deleted__"][i]:
-                    best = None
-                else:
-                    best = {}
-                    for c in tablet.columns:
-                        if c == "__rowid__" or c not in arrays:
-                            continue
-                        vd = valids.get(c)
-                        best[c] = (None if vd is not None and not vd[i]
-                                   else arrays[c][i].item()
-                                   if hasattr(arrays[c][i], "item")
-                                   else arrays[c][i])
+        best = point_lookup(tablet, key, snap, tx_id)
         if best is None:
             return None
+        best.pop("__rowid__", None)
         return {c: best.get(c) for c in (columns or best)}
 
     def delete(self, key, tx=None) -> bool:

@@ -37,6 +37,7 @@ class Tenant:
         self.wal = PalfCluster(wal_replicas, log_root=wal_dir)
         self.wal.elect()
         self.tx = TransService(wal=self.wal)
+        self.tx.engine = self.engine  # secondary-index maintenance
 
         ldr = self.wal.replicas[self.wal.leader_id]
         start = self.engine.meta.get("wal_lsn", 0)

@@ -127,11 +127,29 @@ class CreateTableStmt:
     # PARTITION BY RANGE(col): (col, [upper-exclusive bounds]) or None
     partition: tuple | None = None
     as_select: object = None  # CREATE TABLE ... AS SELECT
+    # inline secondary indexes: list[(name|None, [cols], unique)]
+    indexes: list = field(default_factory=list)
 
 
 @dataclass
 class DropTableStmt:
     name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreateIndexStmt:
+    name: str
+    table: str
+    columns: list            # list[str]
+    unique: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropIndexStmt:
+    name: str
+    table: str
     if_exists: bool = False
 
 

@@ -878,8 +878,39 @@ class Parser:
         v = self._int_token()
         return -v if neg else v
 
+    def _parse_paren_idents(self) -> list[str]:
+        self.expect_op("(")
+        out = [self.expect_ident()]
+        while self.accept_op(","):
+            out.append(self.expect_ident())
+        self.expect_op(")")
+        return out
+
+    def parse_create_index(self, unique: bool):
+        """CREATE [UNIQUE] INDEX [IF NOT EXISTS] name ON table (cols)."""
+        self.expect_kw("index")
+        if_not_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self.expect_ident()
+        self.expect_kw("on")
+        table = self.expect_ident()
+        cols = self._parse_paren_idents()
+        return ast.CreateIndexStmt(name, table, cols, unique,
+                                   if_not_exists)
+
     def parse_create(self):
         self.expect_kw("create")
+        unique = False
+        if self.peek().kind == "ident" and self.peek().value == "unique":
+            self.next()
+            unique = True
+        if self.at_kw("index"):
+            return self.parse_create_index(unique)
+        if unique:
+            raise ParseError("expected INDEX after CREATE UNIQUE")
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):
@@ -895,6 +926,7 @@ class Parser:
         self.expect_op("(")
         cols = []
         pk: list[str] = []
+        inline_indexes: list = []
         while True:
             if self.accept_kw("primary"):
                 self.expect_kw("key")
@@ -903,6 +935,23 @@ class Parser:
                 while self.accept_op(","):
                     pk.append(self.expect_ident())
                 self.expect_op(")")
+            elif self.peek().kind == "ident" and \
+                    self.peek().value == "unique" and \
+                    self.peek(1).kind == "kw" and \
+                    self.peek(1).value in ("key", "index"):
+                # UNIQUE KEY [name] (cols) / UNIQUE INDEX [name] (cols)
+                self.next()
+                self.next()
+                iname = (self.expect_ident()
+                         if self.peek().kind == "ident" else None)
+                inline_indexes.append((iname, self._parse_paren_idents(),
+                                       True))
+            elif self.at_kw("index") or self.at_kw("key"):
+                self.next()
+                iname = (self.expect_ident()
+                         if self.peek().kind == "ident" else None)
+                inline_indexes.append((iname, self._parse_paren_idents(),
+                                       False))
             else:
                 cname = self.expect_ident()
                 dtype = self.parse_type()
@@ -969,10 +1018,23 @@ class Parser:
                     break
             self.expect_op(")")
             partition = (pcol, bounds)
-        return ast.CreateTableStmt(name, cols, pk, if_not_exists, partition)
+        stmt = ast.CreateTableStmt(name, cols, pk, if_not_exists,
+                                   partition)
+        stmt.indexes = inline_indexes
+        return stmt
 
     def parse_drop(self):
         self.expect_kw("drop")
+        if self.accept_kw("index"):
+            # DROP INDEX [IF EXISTS] name ON table
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            name = self.expect_ident()
+            self.expect_kw("on")
+            table = self.expect_ident()
+            return ast.DropIndexStmt(name, table, if_exists)
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):

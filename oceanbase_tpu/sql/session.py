@@ -209,6 +209,10 @@ class Session:
         if isinstance(stmt, ast.DropTableStmt):
             self.catalog.drop_table(stmt.name, if_exists=stmt.if_exists)
             return _ok()
+        if isinstance(stmt, ast.CreateIndexStmt):
+            return self._create_index(stmt)
+        if isinstance(stmt, ast.DropIndexStmt):
+            return self._drop_index(stmt)
         if isinstance(stmt, ast.InsertStmt):
             return self._insert(stmt, params)
         if isinstance(stmt, ast.UpdateStmt):
@@ -278,6 +282,10 @@ class Session:
             if td.primary_key:
                 parts.append("  PRIMARY KEY (" +
                              ", ".join(td.primary_key) + ")")
+            for ix in getattr(td, "indexes", []):
+                kw = "UNIQUE KEY" if ix.unique else "KEY"
+                parts.append(f"  {kw} {ix.name} (" +
+                             ", ".join(ix.columns) + ")")
             text = (f"CREATE TABLE {td.name} (\n" + ",\n".join(parts) +
                     "\n)")
             if td.partition:
@@ -647,6 +655,7 @@ class Session:
         tables = {t: self._table_snapshot(t)
                   for t in referenced_tables(plan)
                   if self.catalog.has_table(t)}
+        self._last_access_paths = self._index_prefilter(plan, tables)
         monitor = None
         if self.db is not None and \
                 getattr(self.db, "plan_monitor", None) is not None and \
@@ -676,6 +685,73 @@ class Session:
                 plan.fingerprint()[:64] if hasattr(plan, "fingerprint")
                 else "", monitor, time.time() - t0)
         return self._materialize(rel, outputs)
+
+    def _index_prefilter(self, plan, tables) -> dict:
+        """Candidate-superset access paths (sql/access_path.py): replace
+        a filtered table's device relation with a small host-pruned
+        candidate set.  The plan re-applies its full filter, so the
+        substitution never changes results — only how few rows reach the
+        device.  -> {table: AccessChoice} for EXPLAIN."""
+        if self.db is None or not tables:
+            return {}
+        if not bool(self.variables.get("enable_index_access", 1)):
+            return {}
+        from oceanbase_tpu.sql import access_path as ap
+
+        try:
+            by_table = ap.scan_filter_ranges(plan, self._engine)
+        except Exception:
+            return {}
+        choices: dict = {}
+        for t, ranges in by_table.items():
+            if t not in tables or t not in self._engine.tables:
+                continue
+            choice = ap.choose_path(self._engine, t, ranges)
+            if choice is None:
+                continue
+            if self._tx is not None:
+                snap, txid = self._tx.snapshot, self._tx.tx_id
+            else:
+                snap, txid = self._txsvc.gts.current(), 0
+            try:
+                arrays, valids = ap.materialize_candidates(
+                    self._engine, choice, snap, txid)
+            except Exception:
+                continue  # any surprise -> keep the full-table path
+            tables[t] = self._candidate_relation(
+                self._engine.tables[t], arrays, valids)
+            choices[t] = choice
+        return choices
+
+    @staticmethod
+    def _candidate_relation(ts, arrays, valids):
+        """Host candidate arrays -> device Relation padded to a power-of-
+        two capacity (bounds jit-cache entries) with a live-row mask."""
+        import jax.numpy as jnp
+
+        from oceanbase_tpu.vector import Relation
+
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        cap = 1
+        while cap < max(n, 1):
+            cap <<= 1
+        types = {c.name: c.dtype for c in ts.tdef.columns}
+        if cap > n:
+            pad = cap - n
+            arrays = {
+                c: np.concatenate([
+                    a, np.array([""] * pad, dtype=object)
+                    if a.dtype == object else np.zeros(pad, dtype=a.dtype)])
+                for c, a in arrays.items()}
+            valids = {c: np.concatenate(
+                [v if v is not None else np.ones(n, dtype=bool),
+                 np.zeros(pad, dtype=bool)])
+                for c, v in valids.items()}
+        rel = from_numpy(
+            arrays, types=types,
+            valids={k: v for k, v in valids.items() if v is not None})
+        mask = jnp.asarray(np.arange(cap) < n)
+        return Relation(columns=rel.columns, mask=mask)
 
     def _px_dop(self) -> int:
         """Effective degree of parallelism.  A session px_dop wins over the
@@ -757,6 +833,26 @@ class Session:
             row_counts = dict(zip(_postorder_ids(plan),
                                   (cnt for _n, cnt in monitor)))
         text = format_plan(plan, row_counts=row_counts)
+        # access-path annotations (≙ the 'Outputs & filters ... access'
+        # section of the reference's EXPLAIN)
+        if self.db is not None:
+            from oceanbase_tpu.sql import access_path as ap
+
+            try:
+                by_table = ap.scan_filter_ranges(plan, self._engine)
+                for t in sorted(by_table):
+                    if t not in self._engine.tables:
+                        continue
+                    choice = ap.choose_path(self._engine, t, by_table[t])
+                    if choice is None:
+                        continue
+                    via = ("PRIMARY" if choice.kind == "primary"
+                           else f"INDEX {choice.index_name}")
+                    text += (f"\naccess: {t} via {via} "
+                             f"(~{choice.est_rows} rows, "
+                             f"cols {sorted(choice.prune)})")
+            except Exception:
+                pass
         lines = np.array(text.splitlines(), dtype=object)
         return Result(["plan"], {"plan": lines}, {},
                       {"plan": SqlType.string()}, rowcount=len(lines),
@@ -774,7 +870,22 @@ class Session:
         tdef = TableDef(stmt.name, cols, primary_key=stmt.primary_key,
                         partition=getattr(stmt, "partition", None),
                         auto_increment_cols=auto_cols)
+        if getattr(stmt, "indexes", None) and self.db is None:
+            # capability check BEFORE create_table: a failure must not
+            # leave a half-created table behind
+            raise NotImplementedError(
+                "secondary indexes need the storage engine")
+        existed = stmt.if_not_exists and self.catalog.has_table(stmt.name)
         self.catalog.create_table(tdef, if_not_exists=stmt.if_not_exists)
+        if existed:
+            return _ok()  # IF NOT EXISTS no-op: skip index/sequence setup
+        # inline INDEX/UNIQUE KEY specs become secondary indexes (the
+        # table is brand-new: nothing to backfill or drain)
+        for i, (iname, icols, iuniq) in enumerate(
+                getattr(stmt, "indexes", [])):
+            self._engine.create_index(
+                stmt.name, iname or f"idx_{stmt.name}_{i}", icols,
+                unique=iuniq)
         # AUTO_INCREMENT backs onto a hidden persisted sequence (≙ table
         # auto-inc service riding the sequence allocator); the column list
         # itself persists with the table definition
@@ -803,6 +914,68 @@ class Session:
 
         rel = Relation(columns=rel.columns, mask=jnp.zeros(1, dtype=jnp.bool_))
         self.catalog.set_data(stmt.name, rel)
+        return _ok()
+
+    def _create_index(self, stmt: ast.CreateIndexStmt) -> Result:
+        """CREATE [UNIQUE] INDEX: engine-side index table + backfill
+        (≙ ObDDLService index build); the plan cache invalidates via the
+        schema-version bump so access paths re-resolve."""
+        if self.db is None:
+            raise NotImplementedError(
+                "CREATE INDEX needs the storage engine")
+        td = self.catalog.table_def(stmt.table)
+        if any(ix.name == stmt.name for ix in td.indexes):
+            if stmt.if_not_exists:
+                return _ok()
+            raise ValueError(f"index {stmt.name} exists on {stmt.table}")
+        if self._tx is not None and stmt.table in self._tx.participants:
+            raise RuntimeError(
+                "CREATE INDEX on a table already written by the open "
+                "transaction is not supported (commit first)")
+        self._engine.create_index(
+            stmt.table, stmt.name, stmt.columns, unique=stmt.unique,
+            drain=self._tx_drain_fence())
+        self.catalog.invalidate(stmt.table)
+        self.catalog.schema_version += 1
+        return _ok()
+
+    def _tx_drain_fence(self, timeout_s: float = 10.0):
+        """-> callable waiting out transactions live NOW (their earlier
+        writes predate index maintenance); the online-DDL write fence
+        (≙ ObDDLService waiting on the schema-version tx barrier)."""
+        svc = self._txsvc
+        with svc._lock:
+            live_before = set(svc._live)
+        if self._tx is not None:
+            # the session's own open transaction cannot be waited on —
+            # it must not have written the table yet, or index creation
+            # inside it would deadlock; mirror MySQL's implicit-commit
+            # by refusing instead of hanging
+            live_before.discard(self._tx.tx_id)
+
+        def drain():
+            deadline = time.time() + timeout_s
+            while True:
+                with svc._lock:
+                    if not (live_before & set(svc._live)):
+                        return
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        "CREATE INDEX timed out waiting for in-flight "
+                        "transactions to finish")
+                time.sleep(0.01)
+        return drain
+
+    def _drop_index(self, stmt: ast.DropIndexStmt) -> Result:
+        if self.db is None:
+            raise NotImplementedError("DROP INDEX needs the storage engine")
+        try:
+            self._engine.drop_index(stmt.table, stmt.name)
+        except KeyError:
+            if not stmt.if_exists:
+                raise
+        self.catalog.invalidate(stmt.table)
+        self.catalog.schema_version += 1
         return _ok()
 
     # ------------------------------------------------------------------
@@ -936,21 +1109,39 @@ class Session:
     def _matching_rows(self, table: str, where, params, tx):
         """-> (rel, mask, tablet): relation at the statement tx's snapshot
         + WHERE mask (reads and writes share one snapshot so the SI
-        write-conflict check is sound)."""
+        write-conflict check is sound).
+
+        Point/range WHERE clauses on the primary key or an index take the
+        candidate-superset access path — an OLTP UPDATE/DELETE touches a
+        few pruned chunks, not a whole-table materialization."""
         from oceanbase_tpu.expr.compile import eval_predicate
         from oceanbase_tpu.sql.binder import Binder, Scope
 
-        tablet = self._engine.tables[table].tablet
-        rel = self.catalog.table_data_at(table, tx.snapshot, tx.tx_id)
+        ts = self._engine.tables[table]
+        tablet = ts.tablet
         binder = Binder(self.catalog, params=params or [])
         scope = Scope()
-        for cname in rel.columns:
+        for cname in tablet.columns:
             scope.add(cname, cname, alias=table)
-        if where is not None:
-            pred = binder.bind_expr(where, scope)
-            mask = eval_predicate(pred, rel)
-        else:
-            mask = rel.mask_or_true()
+        pred = binder.bind_expr(where, scope) if where is not None else None
+        rel = None
+        if pred is not None and \
+                bool(self.variables.get("enable_index_access", 1)):
+            from oceanbase_tpu.sql import access_path as ap
+
+            try:
+                ranges = ap.ranges_of_pred(pred, tablet.types)
+                choice = ap.choose_path(self._engine, table, ranges)
+                if choice is not None:
+                    arrays, valids = ap.materialize_candidates(
+                        self._engine, choice, tx.snapshot, tx.tx_id)
+                    rel = self._candidate_relation(ts, arrays, valids)
+            except Exception:
+                rel = None  # any surprise -> full-table path
+        if rel is None:
+            rel = self.catalog.table_data_at(table, tx.snapshot, tx.tx_id)
+        mask = eval_predicate(pred, rel) if pred is not None \
+            else rel.mask_or_true()
         return rel, mask, tablet, binder, scope
 
     def _update_tx(self, stmt: ast.UpdateStmt, params) -> Result:

@@ -88,6 +88,8 @@ class StorageEngine:
                     "partition": (list(ts.tdef.partition)
                                   if ts.tdef.partition else None),
                     "auto_increment": list(ts.tdef.auto_increment_cols),
+                    "indexes": [[ix.name, list(ix.columns), ix.unique]
+                                for ix in ts.tdef.indexes],
                     "segments": [[s.segment_id, s.level, part]
                                  for s, part in
                                  ts.tablet.segment_locations()],
@@ -119,6 +121,12 @@ class StorageEngine:
                                                           []))
                 self._install_table(tdef, log=False)
                 ts = self.tables[name]
+                from oceanbase_tpu.catalog import IndexDef
+
+                for iname, icols, iuniq in t.get("indexes", []):
+                    ts.tdef.indexes.append(IndexDef(
+                        iname, name, list(icols), iuniq,
+                        self.index_storage_name(name, iname)))
                 for entry in t["segments"]:
                     seg_id, level = entry[0], entry[1]
                     part_idx = entry[2] if len(entry) > 2 else None
@@ -165,6 +173,21 @@ class StorageEngine:
                                      op["column"], log=False)
                 except KeyError:
                     pass
+        elif kind == "create_index":
+            from oceanbase_tpu.catalog import IndexDef
+
+            ts = self.tables.get(op["table"])
+            if ts is not None and not any(ix.name == op["name"]
+                                          for ix in ts.tdef.indexes):
+                ts.tdef.indexes.append(IndexDef(
+                    op["name"], op["table"], list(op["columns"]),
+                    op["unique"],
+                    self.index_storage_name(op["table"], op["name"])))
+        elif kind == "drop_index":
+            ts = self.tables.get(op["table"])
+            if ts is not None:
+                ts.tdef.indexes = [ix for ix in ts.tdef.indexes
+                                   if ix.name != op["name"]]
         elif kind == "add_segment":
             ts = self.tables.get(op["table"])
             if ts is not None:
@@ -262,6 +285,11 @@ class StorageEngine:
                 cname = column
                 if cname in tdef.primary_key:
                     raise ValueError("cannot drop a primary-key column")
+                for ix in tdef.indexes:
+                    if cname in ix.columns:
+                        raise ValueError(
+                            f"cannot drop column {cname!r}: used by "
+                            f"index {ix.name} (drop the index first)")
                 if getattr(tab, "part_col", None) == cname:
                     raise ValueError("cannot drop the partition column")
                 if not any(c.name == cname for c in tdef.columns):
@@ -310,6 +338,181 @@ class StorageEngine:
             for t in tablets:
                 t.data_version += 1
 
+    # ------------------------------------------------------------------
+    # secondary indexes (≙ index tables, src/share/schema index DDL +
+    # src/storage/ddl index build tasks)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def index_storage_name(table: str, iname: str) -> str:
+        return f"__idx__{table}__{iname}"
+
+    def create_index(self, table: str, iname: str, columns: list[str],
+                     unique: bool = False, backfill_version: int = 0,
+                     drain=None):
+        """CREATE INDEX: install the index table (key = index columns +
+        primary key columns) and backfill it from the base table's
+        current snapshot as one sorted baseline segment (≙ the DDL
+        service's index build scanning the base and writing the index
+        SSTable, src/storage/ddl/ob_ddl_redo_log_writer.h path).
+
+        Ordering against concurrent DML (≙ the online-DDL write fence):
+        1. install the store table + IndexDef — from here every NEW
+           write runs index maintenance;
+        2. ``drain()`` (supplied by the session layer) waits out
+           transactions live before step 1 — their earlier writes were
+           never maintained and must commit/abort first;
+        3. backfill from a post-drain snapshot — covers everything those
+           transactions committed; entries double-written by step-1
+           maintenance dedup via newest-wins on the identical entry key.
+        Any failure (unique violation, drain timeout) drops the index
+        again, leaving no trace."""
+        from oceanbase_tpu.catalog import IndexDef
+
+        with self._lock:
+            ts = self.tables[table]
+            if any(ix.name == iname for ix in ts.tdef.indexes):
+                raise ValueError(f"index {iname} exists on {table}")
+            for c in columns:
+                ts.tdef.column(c)  # validates existence
+            store = self.index_storage_name(table, iname)
+            if store in self.tables:
+                raise ValueError(f"index table {store} exists")
+            pk = list(ts.tdef.primary_key) or ["__rowid__"]
+            key_cols = list(columns) + [k for k in pk if k not in columns]
+            base_types = ts.tablet.types
+            cols = [ColumnDef(c, base_types[c]) for c in key_cols]
+            idx = IndexDef(iname, table, list(columns), unique, store)
+            itdef = TableDef(store, cols, primary_key=key_cols)
+            self._install_table(itdef)
+            ts.tdef.indexes.append(idx)
+            self._log_meta({"op": "create_index", "table": table,
+                            "name": iname, "columns": list(columns),
+                            "unique": unique})
+        try:
+            if drain is not None:
+                drain()
+            with self._lock:
+                arrays, valids = ts.tablet.snapshot_arrays(
+                    backfill_version or 2**62)
+                entry = {c: arrays[c] for c in key_cols if c in arrays}
+                ev = {c: valids[c] for c in key_cols
+                      if valids.get(c) is not None}
+                n = len(next(iter(entry.values()))) if entry else 0
+                if unique and n:
+                    self._check_unique_batch(idx, entry, ev, n)
+                # the backfill is a free NDV sample for the indexed
+                # columns (feeds access-path cardinality estimates)
+                for c in columns:
+                    if c in entry and n:
+                        ts.tdef.ndv[c] = max(1, len(np.unique(
+                            entry[c].astype("U")
+                            if entry[c].dtype == object else entry[c])))
+                if n:
+                    self.bulk_load(store, entry, ev or None,
+                                   version=max(1, backfill_version))
+        except Exception:
+            self.drop_index(table, iname)
+            raise
+        return idx
+
+    @staticmethod
+    def _check_unique_batch(idx, entry, ev, n):
+        """Reject duplicate index keys among non-NULL entries (MySQL
+        semantics: rows with any NULL index column never conflict)."""
+        live = np.ones(n, dtype=bool)
+        for c in idx.columns:
+            if ev.get(c) is not None:
+                live &= ev[c]
+        keys = [np.asarray(entry[c])[live].astype("U")
+                if entry[c].dtype == object else entry[c][live]
+                for c in idx.columns]
+        if not keys or not len(keys[0]):
+            return
+        order = np.lexsort(keys[::-1])
+        dup = np.ones(len(order), dtype=bool)
+        for k in keys:
+            s = k[order]
+            dup[1:] &= s[1:] == s[:-1]
+        dup[0] = False
+        if dup.any():
+            from oceanbase_tpu.tx.errors import DuplicateKey
+
+            i = int(np.nonzero(dup)[0][0])
+            vals = tuple(k[order][i] for k in keys)
+            raise DuplicateKey(
+                f"duplicate entry {vals} for unique index {idx.name}")
+
+    @staticmethod
+    def _check_unique_existing(ix, itab, entry, ev, n):
+        """Direct-load unique enforcement against COMMITTED index rows:
+        existing live entries inside the batch's value envelope are
+        compared tuple-wise; a match whose pk suffix differs from every
+        batch row carrying that value is a duplicate.  (The tx write
+        path does its own per-row check; this covers LOAD DATA/CTAS.)"""
+        if itab.row_count_estimate() == 0:
+            return
+        from oceanbase_tpu.storage.lookup import range_rows
+
+        live = np.ones(n, dtype=bool)
+        for c in ix.columns:
+            if ev.get(c) is not None:
+                live &= ev[c]
+        if not live.any():
+            return
+        env = {}
+        for c in ix.columns:
+            a = entry[c][live]
+            s = a.astype("U") if a.dtype == object else a
+            env[c] = (a[np.argmin(s)] if a.dtype == object else s.min(),
+                      a[np.argmax(s)] if a.dtype == object else s.max())
+        ikey_cols = itab.key_cols
+        ex, exv = range_rows(itab, env, 2**62, 0, columns=list(ikey_cols))
+        m = len(next(iter(ex.values()))) if ex else 0
+        if m == 0:
+            return
+        n_ix = len(ix.columns)
+        batch_pairs = set()
+        idxs = np.nonzero(live)[0]
+        for i in idxs:
+            val = tuple(entry[c][i] for c in ix.columns)
+            pkv = tuple(entry[c][i] for c in ikey_cols[n_ix:])
+            batch_pairs.add((val, pkv))
+        batch_vals = {v for v, _ in batch_pairs}
+        for j in range(m):
+            if any(exv.get(c) is not None and not exv[c][j]
+                   for c in ix.columns):
+                continue  # NULL entries never conflict
+            val = tuple(ex[c][j].item() if hasattr(ex[c][j], "item")
+                        else ex[c][j] for c in ix.columns)
+            if val not in batch_vals:
+                continue
+            pkv = tuple(ex[c][j].item() if hasattr(ex[c][j], "item")
+                        else ex[c][j] for c in ikey_cols[n_ix:])
+            if (val, pkv) not in batch_pairs:
+                from oceanbase_tpu.tx.errors import DuplicateKey
+
+                raise DuplicateKey(
+                    f"duplicate entry {val} for unique index {ix.name} "
+                    f"(conflicts with existing row)")
+
+    def drop_index(self, table: str, iname: str, log=True):
+        with self._lock:
+            ts = self.tables[table]
+            keep = [ix for ix in ts.tdef.indexes if ix.name != iname]
+            if len(keep) == len(ts.tdef.indexes):
+                raise KeyError(f"no index {iname} on {table}")
+            dropped = next(ix for ix in ts.tdef.indexes
+                           if ix.name == iname)
+            ts.tdef.indexes = keep
+            if log:
+                self._log_meta({"op": "drop_index", "table": table,
+                                "name": iname})
+            # drop the storage table THROUGH drop_table so the slog also
+            # records it — replay must not resurrect an orphan index
+            # table that would block re-creating the index
+            if dropped.storage_table in self.tables:
+                self.drop_table(dropped.storage_table)
+
     def truncate_table(self, name: str, log=True, wal_lsn: int = 0):
         """Drop all data, keep the schema: reinstall a fresh tablet
         (segments unlinked; ≙ TRUNCATE as fast DDL, not row deletes).
@@ -329,6 +532,11 @@ class StorageEngine:
             if log:
                 self._log_meta({"op": "truncate", "table": name,
                                 "wal_lsn": wal_lsn})
+            # secondary indexes empty together with their base table
+            for ix in tdef.indexes:
+                if ix.storage_table in self.tables:
+                    self.truncate_table(ix.storage_table, log=log,
+                                        wal_lsn=wal_lsn)
 
     def reset_memtables(self, name: str):
         """Discard memtable state only, keeping segments — used by WAL
@@ -348,8 +556,12 @@ class StorageEngine:
 
     def drop_table(self, name: str):
         with self._lock:
-            self.tables.pop(name, None)
+            ts = self.tables.pop(name, None)
             self._log_meta({"op": "drop_table", "name": name})
+            if ts is not None:
+                for ix in ts.tdef.indexes:
+                    if ix.storage_table in self.tables:
+                        self.drop_table(ix.storage_table)
 
     def bulk_load(self, name: str, arrays: dict, valids: dict | None = None,
                   version: int = 1):
@@ -373,9 +585,14 @@ class StorageEngine:
                            for i, pa, sel in parts]
             else:
                 targets = [(None, arrays, valids or {})]
+            from oceanbase_tpu.storage.segment import sort_rows_by_keys
+
             for part_idx, pa, pv in targets:
                 tab = (ts.tablet.partitions[part_idx]
                        if part_idx is not None else ts.tablet)
+                if tab.key_cols != ["__rowid__"]:
+                    pa, pv = sort_rows_by_keys(pa, dict(pv or {}),
+                                               tab.key_cols)
                 seg = Segment.build(
                     next(tab._next_seg), 2, pa, ts.tablet.types,
                     pv or None, min_version=version, max_version=version)
@@ -386,6 +603,41 @@ class StorageEngine:
                                     "segment_id": seg.segment_id,
                                     "part": part_idx})
             ts.tdef.row_count = ts.tablet.row_count_estimate()
+            # maintain secondary indexes: the loaded rows' index entries
+            # load the same way (sorted baseline segment per index).
+            # Unique checks here are batch-local; the tx-plane write path
+            # performs the full existing-row check.
+            n = len(next(iter(arrays.values()))) if arrays else 0
+            for ix in ts.tdef.indexes:
+                istore = self.tables[ix.storage_table]
+                ikey = istore.tablet.key_cols
+                entry = {}
+                ev = {}
+                for c in ikey:
+                    if c in arrays:
+                        entry[c] = arrays[c]
+                        if (valids or {}).get(c) is not None:
+                            ev[c] = valids[c]
+                        continue
+                    # a load may omit a nullable indexed column: its
+                    # entries are NULL (never silently dropped — that
+                    # would collapse distinct rows in the index)
+                    if c in (ts.tdef.primary_key or ["__rowid__"]):
+                        raise ValueError(
+                            f"bulk load is missing index key column "
+                            f"{c!r} for index {ix.name}")
+                    t = istore.tablet.types[c]
+                    entry[c] = (np.array([""] * n, dtype=object)
+                                if t.is_string
+                                else np.zeros(n, dtype=t.np_dtype))
+                    ev[c] = np.zeros(n, dtype=bool)
+                if ix.unique and n:
+                    self._check_unique_batch(ix, entry, ev, n)
+                    self._check_unique_existing(ix, istore.tablet,
+                                                entry, ev, n)
+                if n:
+                    self.bulk_load(ix.storage_table, entry, ev or None,
+                                   version=version)
 
     # ------------------------------------------------------------------
     # compaction driving (≙ tenant tablet scheduler ticks)

@@ -1,0 +1,107 @@
+"""Transactional secondary-index maintenance.
+
+Reference analog: the DML write path updating local index tablets in the
+same transaction as the data table (src/storage/ob_dml_running_ctx +
+index-table DAS write tasks; uniqueness via
+src/storage/ob_rowkey_duplication_checker-style lookups).
+
+Every index is an index TABLE whose key is (index columns + primary-key
+columns).  Maintenance runs inside ``TransService.write`` BEFORE the base
+row is written: the pre-image is read through the LSM (own-transaction
+writes visible), stale entries are tombstoned and new entries inserted
+via recursive ``svc.write`` calls — so index writes ride the same WAL
+redo, participant tracking, statement rollback, and recovery replay as
+any other write, for free.
+"""
+
+from __future__ import annotations
+
+from oceanbase_tpu.storage.lookup import point_lookup, range_rows
+
+
+def maintain_indexes(svc, engine, tx, table: str, tablet, key: tuple,
+                     op: str, values: dict):
+    """Write index-table entries matching a base-table write.
+
+    MUST be called before the base ``tablet.write`` so the pre-image is
+    still the old row.  ``values`` must carry every indexed column for
+    insert/update ops (the session DML paths write full rows)."""
+    ts = engine.tables.get(table)
+    if ts is None or not ts.tdef.indexes:
+        return
+    old = point_lookup(tablet, key, tx.snapshot, tx.tx_id)
+    newvals = dict(values)
+    for kc, kv in zip(tablet.key_cols, key):
+        if newvals.get(kc) is None:
+            newvals[kc] = kv
+    for ix in ts.tdef.indexes:
+        istore = engine.tables.get(ix.storage_table)
+        if istore is None:  # index dropped concurrently
+            continue
+        itab = istore.tablet
+        ikey_cols = itab.key_cols
+        old_ekey = (tuple(old.get(c) for c in ikey_cols)
+                    if old is not None else None)
+        if op == "delete":
+            if old_ekey is not None:
+                svc.write(tx, ix.storage_table, itab, old_ekey, "delete",
+                          dict(zip(ikey_cols, old_ekey)))
+            continue
+        new_ekey = tuple(newvals.get(c) for c in ikey_cols)
+        if old_ekey == new_ekey:
+            continue  # indexed columns unchanged
+        if ix.unique and all(newvals.get(c) is not None
+                             for c in ix.columns):
+            _check_unique(svc, tx, ix, itab, new_ekey, ikey_cols)
+        if old_ekey is not None:
+            svc.write(tx, ix.storage_table, itab, old_ekey, "delete",
+                      dict(zip(ikey_cols, old_ekey)))
+        svc.write(tx, ix.storage_table, itab, new_ekey, "insert",
+                  dict(zip(ikey_cols, new_ekey)))
+
+
+def _check_unique(svc, tx, ix, itab, new_ekey: tuple, ikey_cols):
+    """MySQL unique-index semantics: no two live rows may share non-NULL
+    values on all index columns (rows with any NULL never conflict).
+    Own-transaction writes are visible to the check.
+
+    Two layers (≙ the reference locking the index rowkey during the
+    duplicate check):
+    1. snapshot check — committed/own-tx live entries with the same
+       index-column prefix but a different base row -> DuplicateKey;
+    2. dirty check — another transaction's UNCOMMITTED entry with the
+       same prefix -> WriteConflict (fail fast).  The index-table keys of
+       the two writers differ in their pk suffix, so the memtable's
+       write-write conflict detection alone would let both commit; this
+       prefix-level check closes that race."""
+    n_ix = len(ix.columns)
+    prefix = new_ekey[:n_ix]
+    ranges = {c: (v, v) for c, v in zip(ix.columns, prefix)}
+    arrays, _valids = range_rows(itab, ranges, tx.snapshot, tx.tx_id,
+                                 columns=list(ikey_cols))
+    m = len(next(iter(arrays.values()))) if arrays else 0
+    for i in range(m):
+        ek = tuple(arrays[c][i].item()
+                   if hasattr(arrays[c][i], "item") else arrays[c][i]
+                   for c in ikey_cols)
+        if ek[n_ix:] != new_ekey[n_ix:]:  # a different base row
+            from oceanbase_tpu.tx.errors import DuplicateKey
+
+            raise DuplicateKey(
+                f"duplicate entry {prefix} for unique index {ix.name}")
+    from oceanbase_tpu.storage.lookup import _base_tablets
+
+    for t in _base_tablets(itab):
+        for mt in [t.active] + t.frozen:
+            with mt._lock:
+                for key, head in mt._rows.items():
+                    if key[:n_ix] != prefix or key == new_ekey:
+                        continue
+                    if head.commit_version == 0 and \
+                            head.tx_id != tx.tx_id and \
+                            head.op != "delete":
+                        from oceanbase_tpu.tx.errors import WriteConflict
+
+                        raise WriteConflict(
+                            f"unique index {ix.name} value {prefix} "
+                            f"being inserted by tx {head.tx_id}")

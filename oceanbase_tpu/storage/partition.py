@@ -147,9 +147,24 @@ class PartitionedTablet:
         return out or None
 
     # ------------------------------------------------------------------
-    def snapshot_arrays(self, snapshot: int, tx_id: int = 0):
-        parts = [p.snapshot_arrays(snapshot, tx_id)
-                 for p in self.partitions]
+    def snapshot_arrays(self, snapshot: int, tx_id: int = 0, prune=None):
+        live = self.partitions
+        if prune and self.part_col in prune:
+            lo, hi = prune[self.part_col]
+            first = 0 if lo is None else \
+                bisect.bisect_right(self.bounds, lo)
+            last = len(self.partitions) - 1 if hi is None else \
+                bisect.bisect_right(self.bounds, hi)
+            live = self.partitions[first:last + 1]
+        # chunk-level pruning below the partition router is only sound on
+        # key columns (see Tablet.snapshot_arrays); partition-level routing
+        # on part_col is sound regardless because a row's partition is
+        # derived from the very value being ranged on
+        sub = ({k: v for k, v in prune.items()
+                if k in self.partitions[0].key_cols} or None) if prune \
+            else None
+        parts = [p.snapshot_arrays(snapshot, tx_id, prune=sub)
+                 for p in live]
         arrays: dict = {}
         valids: dict = {}
         for c in self.columns:

@@ -172,6 +172,33 @@ class Segment:
                        cols, types, meta["min_version"], meta["max_version"])
 
 
+def sort_rows_by_keys(arrays: dict, valids: dict, key_cols: list[str]):
+    """STABLY sort row arrays by the key columns (oldest-first order of
+    equal keys is preserved, so position-based newest-wins dedup in
+    ``snapshot_arrays`` stays correct).
+
+    Key-sorted segments are the TPU build's primary index: each chunk's
+    zone map on the key columns becomes a tight range, so point/range
+    lookups decode only the chunks that can contain the key
+    (≙ the index-block row scanner seeking macro/micro blocks,
+    src/storage/blocksstable/index_block/ob_index_block_row_scanner.h)."""
+    present = [k for k in key_cols if k in arrays]
+    if not present:
+        return arrays, valids
+    n = len(next(iter(arrays.values()))) if arrays else 0
+    if n <= 1:
+        return arrays, valids
+    sort_keys = []
+    for k in reversed(present):  # lexsort: last key is primary
+        a = arrays[k]
+        sort_keys.append(a.astype("U") if a.dtype == object else a)
+    order = np.lexsort(sort_keys)
+    out_a = {c: a[order] for c, a in arrays.items()}
+    out_v = {c: (v[order] if v is not None else None)
+             for c, v in valids.items()}
+    return out_a, out_v
+
+
 def _scalar(v):
     if isinstance(v, (np.integer,)):
         return int(v)
@@ -259,6 +286,8 @@ def merge_segments(segment_id: int, level: int, segments: list,
 
     out_arrays = {n: stacked[n][keep] for n in stacked}
     out_valids = {n: v[keep] for n, v in stacked_valid.items()}
+    out_arrays, out_valids = sort_rows_by_keys(out_arrays, out_valids,
+                                               key_cols)
     return Segment.build(
         segment_id, level, out_arrays, types, out_valids,
         min_version=min(s.min_version for s in segments),

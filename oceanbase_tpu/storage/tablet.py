@@ -138,6 +138,10 @@ class Tablet:
                 leftovers.append(mt.leftover_versions(snapshot))
             merged_arrays, merged_valids = _stack_parts(parts, self.columns,
                                                         self.types)
+            from oceanbase_tpu.storage.segment import sort_rows_by_keys
+
+            merged_arrays, merged_valids = sort_rows_by_keys(
+                merged_arrays, merged_valids, self.key_cols)
             seg = Segment.build(
                 next(self._next_seg), 0, merged_arrays,
                 {**self.types, "__deleted__": SqlType.bool_(),
@@ -199,14 +203,34 @@ class Tablet:
     # ------------------------------------------------------------------
     # snapshot read
     # ------------------------------------------------------------------
-    def snapshot_arrays(self, snapshot: int, tx_id: int = 0):
-        """-> (arrays, valids) visible at ``snapshot`` (plus own tx)."""
+    def snapshot_arrays(self, snapshot: int, tx_id: int = 0, prune=None):
+        """-> (arrays, valids) visible at ``snapshot`` (plus own tx).
+
+        ``prune``: optional {key_col: (lo, hi)} inclusive ranges used for
+        zone-map chunk pruning (≙ blockscan skipping via index blocks).
+        SOUNDNESS: pruning columns MUST be key columns — every version of
+        a key (including tombstones) carries identical key-column values,
+        so a chunk mask derived from key ranges either keeps every version
+        of a key or drops every version; newest-wins dedup stays correct
+        for all surviving keys.  Pruning on a non-key column could split a
+        version chain and resurrect stale rows."""
+        if prune:
+            assert set(prune) <= set(self.key_cols), \
+                "zone-map pruning is only sound on key columns"
         with self._lock:
             seg_parts = []
             for seg in self.segments:
                 if seg.min_version > snapshot:
                     continue  # wholly invisible at this snapshot
-                a, v = seg.decode()
+                if prune:
+                    cm = np.ones(seg.n_chunks, dtype=bool)
+                    for pc, (lo, hi) in prune.items():
+                        cm &= seg.prune_chunks(pc, lo, hi)
+                    if not cm.any():
+                        continue
+                    a, v = seg.decode(chunk_mask=None if cm.all() else cm)
+                else:
+                    a, v = seg.decode()
                 if seg.max_version > snapshot and "__version__" in a:
                     vis = a["__version__"] <= snapshot
                     a = {k: arr[vis] for k, arr in a.items()}

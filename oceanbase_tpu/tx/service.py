@@ -80,6 +80,9 @@ class TransService:
         self.wal = wal            # PalfCluster or None (no replication)
         self.lock_table = None    # tx/tablelock.LockTable when attached
         self.lock_wait_timeout_s = 5.0
+        # StorageEngine for secondary-index maintenance (set by the
+        # tenant wiring); None disables maintenance (e.g. bare unit use)
+        self.engine = None
         self._next_tx = itertools.count(1)
         self._live: dict[int, Transaction] = {}
         self._lock = threading.RLock()
@@ -100,6 +103,15 @@ class TransService:
             # READ/WRITE held by other transactions (released at tx end)
             self.lock_table.acquire(table, "IX", tx.tx_id,
                                     timeout=self.lock_wait_timeout_s)
+        if self.engine is not None:
+            # secondary indexes update in the SAME transaction, before
+            # the base write (pre-image must still be the old row);
+            # recursive svc.write calls give index entries WAL redo,
+            # statement rollback, and replay for free
+            from oceanbase_tpu.storage.indexes import maintain_indexes
+
+            maintain_indexes(self, self.engine, tx, table, tablet, key,
+                             op, values)
         tablet.write(key, op, values, tx.tx_id, stmt_seq=tx.stmt_seq,
                      snapshot=tx.snapshot)
         p = tx.participant(table, tablet)
@@ -250,19 +262,30 @@ class TransService:
                 pending.pop(rec["tx"], None)
             elif op == "truncate":
                 # replayed in log order: discard everything replayed into
-                # the table so far (≙ TRUNCATE barrier in the redo stream)
+                # the table so far (≙ TRUNCATE barrier in the redo stream).
+                # Secondary-index storage tables truncate with their base:
+                # their redo replays alongside the base rows, so the
+                # barrier must clear them identically or recovered index
+                # entries would resurrect pre-truncate values.
                 table = rec["table"]
-                if e.lsn <= engine.truncate_barriers.get(table, 0):
-                    # the slog already applied this truncate AND restored
-                    # post-truncate direct-load segments; only clear what
-                    # WAL replay itself put into the memtables
-                    engine.reset_memtables(table)
-                elif table in engine.tables:
-                    engine.truncate_table(table, log=False)
+                targets = [table]
+                base = engine.tables.get(table)
+                if base is not None:
+                    targets += [ix.storage_table
+                                for ix in base.tdef.indexes]
+                for t in targets:
+                    if e.lsn <= engine.truncate_barriers.get(t, 0):
+                        # the slog already applied this truncate AND
+                        # restored post-truncate direct-load segments;
+                        # only clear what WAL replay put into memtables
+                        engine.reset_memtables(t)
+                    elif t in engine.tables:
+                        engine.truncate_table(t, log=False)
                 # drop buffered redo of the table (writers finish before
                 # the barrier thanks to the X table lock; belt-and-braces)
+                tset = set(targets)
                 for recs in pending.values():
-                    recs[:] = [r for r in recs if r["table"] != table]
+                    recs[:] = [r for r in recs if r["table"] not in tset]
         return max_ts
 
 

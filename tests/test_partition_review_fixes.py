@@ -9,7 +9,9 @@ def test_partition_moving_update(tmp_path):
     # finding 1: UPDATE moving the partition key must not duplicate the row
     db = Database(str(tmp_path / "db"))
     s = db.session()
-    s.execute("create table t (k int primary key, v int) "
+    # the partition column must be part of the PK (MySQL/OceanBase rule,
+    # enforced since r2) — a composite PK still exercises the move
+    s.execute("create table t (k int, v int, primary key (k, v)) "
               "partition by range (v) ("
               "partition p0 values less than (100), "
               "partition p1 values less than maxvalue)")
