@@ -147,16 +147,23 @@ def dist_join_shard(
     out_capacity: int,
     how: str = "inner",
     axis_name: str = PX_AXIS,
+    probe_cap_per_dest: int | None = None,
 ):
     """HASH-HASH distributed join: repartition both inputs on the join key
     so matching keys co-locate, then local sort-join per chip
     (≙ PX HASH dist join, ObSliceIdxCalc::SliceCalcType HASH both sides).
 
+    ``probe_cap_per_dest`` lets a runtime join filter budget the probe
+    exchange below the build exchange (bloom-filtered probes carry far
+    fewer live rows).
+
     Returns (relation, global overflow count); see dist_groupby_shard."""
     from oceanbase_tpu.exec.ops import join
 
-    lrecv, lov = all_to_all_repartition(left, left_keys, ndev, cap_per_dest,
-                                        axis_name)
+    lrecv, lov = all_to_all_repartition(
+        left, left_keys, ndev,
+        probe_cap_per_dest if probe_cap_per_dest is not None
+        else cap_per_dest, axis_name)
     rrecv, rov = all_to_all_repartition(right, right_keys, ndev, cap_per_dest,
                                         axis_name)
     out = join(lrecv, rrecv, left_keys, right_keys, how=how,

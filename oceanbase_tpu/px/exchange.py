@@ -81,6 +81,86 @@ def shard_relation(rel: Relation, mesh, axis: str = PX_AXIS) -> Relation:
     return Relation(columns=cols, mask=jax.device_put(pad_mask, sharding))
 
 
+_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_M2 = np.uint64(0x94D049BB133111EB)
+
+
+def _np_mix64(x: np.ndarray) -> np.ndarray:
+    """Host mirror of exec.ops._mix64 — MUST stay bit-identical so a
+    host-side hash shard co-locates with device-side hash exchanges."""
+    with np.errstate(over="ignore"):
+        x = x.astype(np.uint64)
+        x = (x ^ (x >> np.uint64(30))) * _M1
+        x = (x ^ (x >> np.uint64(27))) * _M2
+        return x ^ (x >> np.uint64(31))
+
+
+def shard_relation_by_hash(rel: Relation, key_cols: Sequence[str], mesh,
+                           axis: str = PX_AXIS) -> Relation:
+    """Hash-shard a device relation by key columns: rows with equal keys
+    land on the same chip, so a join between two relations sharded on
+    their join keys needs NO exchange (partition-wise join / PKEY
+    distribution, ≙ ob_pwj_comparer.h matching + PKEY slice routing).
+
+    Mirrors the device hash exactly for the single-int fast path and the
+    multi-key mix; key columns must be non-string (dict codes are
+    relation-local).  NULL-key rows hash on 0 — they never match an
+    equi-join, any placement works."""
+    ndev = mesh.devices.size
+    datas = []
+    for c in key_cols:
+        col = rel.columns[c]
+        d = np.asarray(col.data).astype(np.int64)
+        if col.valid is not None:
+            d = np.where(np.asarray(col.valid), d, 0)
+        datas.append(d)
+    if len(datas) == 1:
+        k = datas[0]
+    else:
+        h = np.zeros(len(datas[0]), dtype=np.uint64)
+        for d in datas:
+            h = _np_mix64(h ^ _np_mix64(d.astype(np.uint64)))
+        k = h.astype(np.int64)
+    dest = (_np_mix64(k.astype(np.uint64)) % np.uint64(ndev)).astype(
+        np.int64)
+    n = rel.capacity
+    mask = np.ones(n, dtype=bool) if rel.mask is None \
+        else np.asarray(rel.mask)
+    dest = np.where(mask, dest, ndev)  # dead rows fill the shortest shard
+    order = np.argsort(dest, kind="stable")
+    counts = np.bincount(dest[order], minlength=ndev + 1)[:ndev]
+    cap = int(max(counts.max(initial=0), 1))
+    cap = ((cap + 7) // 8) * 8  # mild alignment
+    sharding = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(axis))
+
+    # slot assignment: row j of bucket b -> b*cap + j; dead rows pad
+    pos = np.arange(n)
+    sd = dest[order]
+    in_bucket = pos - np.concatenate(
+        [[0], np.cumsum(np.bincount(sd, minlength=ndev + 1))])[sd]
+    live_rows = sd < ndev
+    slot_of_sorted = np.where(live_rows, sd * cap + in_bucket, -1)
+
+    out_mask = np.zeros(ndev * cap, dtype=bool)
+    taken = slot_of_sorted[live_rows]
+    out_mask[taken] = mask[order][live_rows]
+    cols = {}
+    for name, c in rel.columns.items():
+        d = np.asarray(c.data)
+        buf = np.zeros((ndev * cap,) + d.shape[1:], dtype=d.dtype)
+        buf[taken] = d[order][live_rows]
+        v2 = None
+        if c.valid is not None:
+            v = np.asarray(c.valid)
+            vbuf = np.zeros(ndev * cap, dtype=bool)
+            vbuf[taken] = v[order][live_rows]
+            v2 = jax.device_put(vbuf, sharding)
+        cols[name] = Column(jax.device_put(buf, sharding), v2, c.dtype,
+                            c.sdict)
+    return Relation(columns=cols, mask=jax.device_put(out_mask, sharding))
+
+
 def unshard_relation(rel: Relation) -> Relation:
     """Gather a sharded relation back to one addressable array set."""
     cols = {
@@ -104,23 +184,22 @@ def _hash_dest(rel: Relation, keys: Sequence[ir.Expr], ndev: int):
     return (h % jnp.uint64(ndev)).astype(jnp.int32)
 
 
-def all_to_all_repartition(
+def exchange_by_dest(
     rel: Relation,
-    keys: Sequence[ir.Expr],
+    dest,
     ndev: int,
     cap_per_dest: int,
     axis_name: str = PX_AXIS,
 ) -> tuple[Relation, jnp.ndarray]:
-    """HASH-repartition the local shard across the mesh axis.
+    """Ship each local row to the shard named by ``dest`` (dead rows must
+    carry dest == ndev, the drop sentinel).  The generic transmit half of
+    every slice strategy — HASH, RANGE, PKEY all reduce to a dest vector
+    (≙ ObSliceIdxCalc::get_slice_indexes + DTL send, as one all_to_all).
 
     Returns (received relation with capacity ndev*cap_per_dest, local
-    overflow count).  Rows with the same key hash land on the same chip.
-    ≙ ObSliceIdxCalc hash slice + DTL send/recv, as one all_to_all.
-    """
+    overflow count)."""
     n = rel.capacity
     m = rel.mask_or_true()
-    dest = jnp.where(m, _hash_dest(rel, keys, ndev), ndev)  # dead -> sentinel
-
     order = jnp.argsort(dest, stable=True)
     s_dest = jnp.take(dest, order)
     # rank within destination bucket
@@ -155,6 +234,23 @@ def all_to_all_repartition(
         )
     out = Relation(columns=recv_cols, mask=ex_mask.reshape(-1))
     return out, overflow
+
+
+def all_to_all_repartition(
+    rel: Relation,
+    keys: Sequence[ir.Expr],
+    ndev: int,
+    cap_per_dest: int,
+    axis_name: str = PX_AXIS,
+) -> tuple[Relation, jnp.ndarray]:
+    """HASH-repartition the local shard across the mesh axis.
+
+    Rows with the same key hash land on the same chip.
+    ≙ ObSliceIdxCalc hash slice + DTL send/recv, as one all_to_all.
+    """
+    m = rel.mask_or_true()
+    dest = jnp.where(m, _hash_dest(rel, keys, ndev), ndev)  # dead -> sentinel
+    return exchange_by_dest(rel, dest, ndev, cap_per_dest, axis_name)
 
 
 def _a2a(x, axis_name):
