@@ -213,6 +213,110 @@ def execute_streamed(plan: pp.PlanNode, chunk_provider,
     return rel
 
 
+def execute_sorted_streamed(
+    plan: pp.PlanNode, chunk_provider, spill_dir: str,
+    chunk_rows: int = DEFAULT_CHUNK_ROWS,
+    budget_rows: int = 1 << 22, types: dict | None = None,
+):
+    """ORDER BY over a table larger than host memory: granules filter on
+    device, live rows drain to host, and the external merge sort
+    (exec/external_sort.py) spills runs to ``spill_dir``.  A Limit above
+    the Sort stops the merge as soon as offset+k rows have emerged —
+    the tail of the merged stream is never read off disk.
+
+    Supported shape: [Project?] [Limit?] Sort over a single-table
+    scan/filter/project subtree with plain column sort keys.
+    -> (arrays, valids) of the final (sorted, limited) host columns."""
+    from oceanbase_tpu.exec.external_sort import external_sort
+    from oceanbase_tpu.storage.tmpfile import TempFileStore
+    from oceanbase_tpu.vector import to_numpy
+
+    top, scalar_agg, droot = split_top(plan)
+    if scalar_agg is not None or isinstance(droot, pp.GroupBy):
+        raise NotDistributable("sorted streaming is for scan pipelines")
+    sort_node = None
+    limit_node = None
+    projects = []
+    for node in top:  # outermost-first
+        if isinstance(node, pp.Sort) and sort_node is None:
+            sort_node = node
+        elif isinstance(node, pp.Limit) and sort_node is None:
+            limit_node = node
+        elif isinstance(node, pp.Project) and sort_node is None:
+            projects.append(node)
+        else:
+            raise NotDistributable("unsupported op above streamed sort")
+    if sort_node is None:
+        raise NotDistributable("no Sort to stream")
+    key_cols = []
+    for k in sort_node.keys:
+        if not isinstance(k, ir.ColumnRef):
+            raise NotDistributable("streamed sort needs column keys")
+        key_cols.append(k.name)
+
+    table = _find_single_scan(droot)
+    gdicts = _global_dicts(chunk_provider, table, chunk_rows)
+    bounds = extract_column_bounds(droot)
+
+    @jax.jit
+    def chunk_fn(tables):
+        return ops.compact(pp._lower_inner(droot, tables))
+
+    def host_chunks():
+        for arrays, valids in chunk_provider(table, chunk_rows, bounds):
+            n = len(next(iter(arrays.values())))
+            if n == 0:
+                continue
+            rel = _chunk_to_relation(arrays, valids, types, gdicts,
+                                     chunk_rows, n)
+            out = chunk_fn({table: rel})
+            host = to_numpy(out)
+            cols = [c for c in host if not c.startswith("__valid__")]
+            a = {c: host[c] for c in cols}
+            v = {c: host.get("__valid__" + c) for c in cols}
+            if len(next(iter(a.values()))) == 0:
+                continue
+            yield a, v
+
+    want = None
+    if limit_node is not None:
+        want = limit_node.k + limit_node.offset
+
+    parts_a: list = []
+    parts_v: list = []
+    got = 0
+    with TempFileStore(spill_dir) as store:
+        for arrays, valids in external_sort(
+                host_chunks(), key_cols, sort_node.ascending, store,
+                budget_rows=budget_rows):
+            parts_a.append(arrays)
+            parts_v.append(valids)
+            got += len(next(iter(arrays.values())))
+            if want is not None and got >= want:
+                break  # early exit: the merge tail stays on disk
+    if not parts_a:
+        return {}, {}
+    cols = list(parts_a[0])
+    arrays = {}
+    valids = {}
+    for c in cols:
+        chunks = [p[c] for p in parts_a]
+        if any(x.dtype == object for x in chunks):
+            chunks = [x.astype(object) for x in chunks]
+        arrays[c] = np.concatenate(chunks)
+        if any(v.get(c) is not None for v in parts_v):
+            valids[c] = np.concatenate(
+                [vv[c] if vv.get(c) is not None
+                 else np.ones(len(a[c]), dtype=bool)
+                 for vv, a in zip(parts_v, parts_a)])
+    if limit_node is not None:
+        lo = limit_node.offset
+        hi = lo + limit_node.k
+        arrays = {c: a[lo:hi] for c, a in arrays.items()}
+        valids = {c: v[lo:hi] for c, v in valids.items()}
+    return arrays, valids
+
+
 def _global_dicts(chunk_provider, table, chunk_rows):
     """Pre-pass: union of unique values per string column -> sorted dict."""
     from oceanbase_tpu.vector.column import StringDict
