@@ -63,25 +63,38 @@ def _slab_order(arrays, valids, key_cols: Sequence[str],
 
 def _lex_le(key_arrays, valid_arrays, ascending, thresh) -> np.ndarray:
     """Vectorized row <= thresh under the multi-key ordering.
-    ``thresh`` is a tuple of (is_null, value) per key."""
+    ``thresh`` is a tuple of (is_null, is_nan, value) per key.
+
+    Order per key: ASC = NULL, values, NaN; DESC = values (desc), NaN,
+    NULL — matching np.lexsort (NaN last in both directions) composed
+    with the _null_rank lane."""
     n = len(key_arrays[0])
     lt = np.zeros(n, dtype=bool)
     eq = np.ones(n, dtype=bool)
-    for (a, v, asc), (t_null, t_val) in zip(
+    for (a, v, asc), (t_null, t_nan, t_val) in zip(
             zip(key_arrays, valid_arrays, ascending), thresh):
         isnull = ~v if v is not None else np.zeros(n, dtype=bool)
+        isnan = (np.isnan(a) & ~isnull if a.dtype.kind == "f"
+                 else np.zeros(n, dtype=bool))
         if t_null:
             # threshold is NULL. ASC: NULL sorts first, so nothing is
             # strictly before it.  DESC: NULL sorts last, so every
-            # non-NULL row precedes it.
+            # non-NULL row (NaN included) precedes it.
             a_lt = np.zeros(n, dtype=bool) if asc else ~isnull
             a_eq = isnull
+        elif t_nan:
+            # threshold is NaN: last among non-NULLs in both directions.
+            # ASC: NULLs and all non-NaN values precede it.  DESC: only
+            # non-NaN values do (NULLs sort after NaN).
+            a_lt = ~isnan if asc else ~isnan & ~isnull
+            a_eq = isnan
         else:
             with np.errstate(invalid="ignore"):
                 raw_lt = a < t_val if asc else a > t_val
                 raw_eq = a == t_val
             # a NULL row precedes any non-NULL threshold under ASC,
-            # never under DESC
+            # never under DESC; a NaN row never precedes a real value
+            # (NaN comparisons are already False)
             a_lt = np.where(isnull, asc, raw_lt)
             a_eq = np.where(isnull, False, raw_eq)
         lt |= eq & a_lt
@@ -90,14 +103,21 @@ def _lex_le(key_arrays, valid_arrays, ascending, thresh) -> np.ndarray:
 
 
 def _row_key(arrays, valids, key_cols, i):
+    """-> ((is_null, is_nan, value), ...) per key.  np.lexsort orders NaN
+    strictly LAST among non-NULL values for ASC and (negated-lane) DESC
+    alike — NaN gets its own comparator rank so the merge comparators
+    agree exactly (collapsing NaN into ±inf would tie it with real
+    infinities that lexsort does NOT tie)."""
     out = []
     for c in key_cols:
         v = valids.get(c)
         if v is not None and not v[i]:
-            out.append((True, None))
+            out.append((True, False, None))
         else:
             x = arrays[c][i]
-            out.append((False, x.item() if hasattr(x, "item") else x))
+            x = x.item() if hasattr(x, "item") else x
+            isnan = isinstance(x, float) and x != x
+            out.append((False, isnan, None if isnan else x))
     return tuple(out)
 
 
@@ -191,13 +211,17 @@ def _merge_two(store: TempFileStore, a_id: int, b_id: int, cols,
 
 
 def _key_le(ta, tb, ascending) -> bool:
-    for (an, av), (bn, bv), asc in zip(ta, tb, ascending):
+    for (an, anan, av), (bn, bnan, bv), asc in zip(ta, tb, ascending):
         if an and bn:
             continue
         if an or bn:
             # NULL smallest in ASC sense; flips under DESC
             smaller_is_a = an if asc else bn
             return smaller_is_a
+        if anan and bnan:
+            continue
+        if anan or bnan:
+            return bnan  # NaN sorts last in both directions
         if av == bv:
             continue
         return (av < bv) if asc else (av > bv)

@@ -314,6 +314,24 @@ def execute_sorted_streamed(
         hi = lo + limit_node.k
         arrays = {c: a[lo:hi] for c, a in arrays.items()}
         valids = {c: v[lo:hi] for c, v in valids.items()}
+    # apply the Project chain above the Sort (innermost-first; Projects
+    # are row-wise so they commute with the Limit slice).  Plain column
+    # selections/renames run on host; computed outputs round-trip the
+    # (already limited / fully materialized) result through the device
+    # expression engine.
+    for node in reversed(projects):
+        if all(isinstance(e, ir.ColumnRef) for e in node.outputs.values()):
+            arrays = {nm: arrays[e.name] for nm, e in node.outputs.items()}
+            valids = {nm: valids.get(e.name)
+                      for nm, e in node.outputs.items()}
+        else:
+            rel = from_numpy(arrays,
+                             valids={c: v for c, v in valids.items()
+                                     if v is not None})
+            host = to_numpy(ops.project(rel, node.outputs))
+            cols = [c for c in host if not c.startswith("__valid__")]
+            arrays = {c: host[c] for c in cols}
+            valids = {c: host.get("__valid__" + c) for c in cols}
     return arrays, valids
 
 

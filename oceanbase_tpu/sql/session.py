@@ -944,16 +944,21 @@ class Session:
         writes predate index maintenance); the online-DDL write fence
         (≙ ObDDLService waiting on the schema-version tx barrier)."""
         svc = self._txsvc
-        with svc._lock:
-            live_before = set(svc._live)
-        if self._tx is not None:
+        own_tx = self._tx.tx_id if self._tx is not None else None
+
+        def drain():
+            # capture the live set HERE — engine.create_index calls the
+            # fence AFTER installing the IndexDef, so every transaction
+            # whose writes could have escaped maintenance is in this set
+            # (a tx beginning between fence construction and IndexDef
+            # install would otherwise be neither maintained nor drained)
+            with svc._lock:
+                live_before = set(svc._live)
             # the session's own open transaction cannot be waited on —
             # it must not have written the table yet, or index creation
             # inside it would deadlock; mirror MySQL's implicit-commit
             # by refusing instead of hanging
-            live_before.discard(self._tx.tx_id)
-
-        def drain():
+            live_before.discard(own_tx)
             deadline = time.time() + timeout_s
             while True:
                 with svc._lock:

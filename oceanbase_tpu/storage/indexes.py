@@ -16,7 +16,62 @@ any other write, for free.
 
 from __future__ import annotations
 
+import threading
+
 from oceanbase_tpu.storage.lookup import point_lookup, range_rows
+
+
+class IndexKeyLocks:
+    """In-flight unique-index rowkey locks.
+
+    ≙ the reference holding an index-rowkey lock across the duplicate
+    check (ObRowkeyDuplicationChecker path): a writer inserting value V
+    into a unique index takes the (index, V) lock before checking and
+    holds it until its transaction ends, so (a) two concurrent inserters
+    of V serialize (the loser fails fast with WriteConflict, matching
+    this build's no-wait conflict model), and (b) the duplicate check is
+    atomic with respect to commit — no window where another transaction
+    commits V between our check and our commit."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._held: dict[tuple, int] = {}    # (index table, prefix) -> tx
+        # tx -> {key: stmt_seq of FIRST acquisition} (statement rollback
+        # must release only locks its statement introduced)
+        self._by_tx: dict[int, dict] = {}
+
+    def acquire(self, table: str, prefix: tuple, tx_id: int,
+                stmt_seq: int = 0):
+        from oceanbase_tpu.tx.errors import WriteConflict
+
+        k = (table, prefix)
+        with self._lock:
+            holder = self._held.get(k)
+            if holder is not None and holder != tx_id:
+                raise WriteConflict(
+                    f"unique index {table} value {prefix} being "
+                    f"inserted by tx {holder}")
+            self._held[k] = tx_id
+            self._by_tx.setdefault(tx_id, {}).setdefault(k, stmt_seq)
+
+    def release_all(self, tx_id: int):
+        with self._lock:
+            for k in self._by_tx.pop(tx_id, {}):
+                if self._held.get(k) == tx_id:
+                    del self._held[k]
+
+    def release_stmt(self, tx_id: int, min_stmt_seq: int):
+        """Release locks first acquired at stmt_seq >= min_stmt_seq (the
+        rolled-back statement's acquisitions; earlier statements keep
+        theirs — their index entries are still pending commit)."""
+        with self._lock:
+            mine = self._by_tx.get(tx_id)
+            if not mine:
+                return
+            for k in [k for k, s in mine.items() if s >= min_stmt_seq]:
+                del mine[k]
+                if self._held.get(k) == tx_id:
+                    del self._held[k]
 
 
 def maintain_indexes(svc, engine, tx, table: str, tablet, key: tuple,
@@ -67,17 +122,27 @@ def _check_unique(svc, tx, ix, itab, new_ekey: tuple, ikey_cols):
 
     Two layers (≙ the reference locking the index rowkey during the
     duplicate check):
-    1. snapshot check — committed/own-tx live entries with the same
-       index-column prefix but a different base row -> DuplicateKey;
-    2. dirty check — another transaction's UNCOMMITTED entry with the
-       same prefix -> WriteConflict (fail fast).  The index-table keys of
-       the two writers differ in their pk suffix, so the memtable's
-       write-write conflict detection alone would let both commit; this
-       prefix-level check closes that race."""
+    1. rowkey lock — the (index, value) lock serializes concurrent
+       inserters of the same value; an uncommitted rival holds it, so we
+       fail fast with WriteConflict instead of scanning memtables;
+    2. committed check — read the index range at the LATEST committed
+       state (not the transaction snapshot: an entry committed after our
+       snapshot by an already-finished transaction must still conflict);
+       any live entry with the same index-column prefix but a different
+       base row -> DuplicateKey.  The lock from layer 1 is held until
+       our transaction ends, so no rival can slip a commit in between
+       this check and ours."""
+    from oceanbase_tpu.storage.lookup import _INF
+
     n_ix = len(ix.columns)
     prefix = new_ekey[:n_ix]
+    svc.index_locks.acquire(ix.storage_table, prefix, tx.tx_id,
+                            stmt_seq=tx.stmt_seq)
     ranges = {c: (v, v) for c, v in zip(ix.columns, prefix)}
-    arrays, _valids = range_rows(itab, ranges, tx.snapshot, tx.tx_id,
+    # read at _INF = the latest committed state plus own-tx writes (own
+    # uncommitted versions rank exactly _INF in _tablet_newest; sharing
+    # the constant keeps that visibility invariant in one place)
+    arrays, _valids = range_rows(itab, ranges, _INF, tx.tx_id,
                                  columns=list(ikey_cols))
     m = len(next(iter(arrays.values()))) if arrays else 0
     for i in range(m):
@@ -89,19 +154,3 @@ def _check_unique(svc, tx, ix, itab, new_ekey: tuple, ikey_cols):
 
             raise DuplicateKey(
                 f"duplicate entry {prefix} for unique index {ix.name}")
-    from oceanbase_tpu.storage.lookup import _base_tablets
-
-    for t in _base_tablets(itab):
-        for mt in [t.active] + t.frozen:
-            with mt._lock:
-                for key, head in mt._rows.items():
-                    if key[:n_ix] != prefix or key == new_ekey:
-                        continue
-                    if head.commit_version == 0 and \
-                            head.tx_id != tx.tx_id and \
-                            head.op != "delete":
-                        from oceanbase_tpu.tx.errors import WriteConflict
-
-                        raise WriteConflict(
-                            f"unique index {ix.name} value {prefix} "
-                            f"being inserted by tx {head.tx_id}")

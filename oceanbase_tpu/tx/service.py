@@ -83,6 +83,11 @@ class TransService:
         # StorageEngine for secondary-index maintenance (set by the
         # tenant wiring); None disables maintenance (e.g. bare unit use)
         self.engine = None
+        # unique-index rowkey locks held across duplicate checks
+        # (≙ index rowkey locking; see storage/indexes.IndexKeyLocks)
+        from oceanbase_tpu.storage.indexes import IndexKeyLocks
+
+        self.index_locks = IndexKeyLocks()
         self._next_tx = itertools.count(1)
         self._live: dict[int, Transaction] = {}
         self._lock = threading.RLock()
@@ -139,6 +144,9 @@ class TransService:
         # drop the statement's buffered redo (it never hit the WAL)
         tx.pending_redo = [r for r in tx.pending_redo
                            if r.get("stmt", 0) < stmt_seq]
+        # index rowkey locks the statement introduced go with it — a
+        # rolled-back INSERT must not wedge its unique value until tx end
+        self.index_locks.release_stmt(tx.tx_id, stmt_seq)
 
     # ------------------------------------------------------------------
     def commit(self, tx: Transaction) -> int:
@@ -207,6 +215,7 @@ class TransService:
 
     # ------------------------------------------------------------------
     def _release_locks(self, tx: Transaction):
+        self.index_locks.release_all(tx.tx_id)
         if self.lock_table is not None:
             self.lock_table.release_all(tx.tx_id)
 
