@@ -63,6 +63,13 @@ DEF("join_capacity_factor", 1.5, "float",
     "safety multiplier over join cardinality estimates", _pos)
 DEF("max_capacity_retry", 3, "int",
     "re-plan attempts (4x budget each) after CapacityOverflow", _nonneg)
+DEF("sql_work_area_rows", 1 << 22, "int",
+    "per-query work-area row budget; inputs estimated above it stream "
+    "through the disk spill tier (≙ ObTenantSqlMemoryManager work areas)",
+    _pos)
+DEF("enable_sql_spill", True, "bool",
+    "route over-budget sorts/joins/group-bys through the temp-file "
+    "spill tier instead of failing on CapacityOverflow")
 DEF("enable_sql_plan_monitor", True, "bool",
     "collect per-operator row counts/timings (≙ sql_plan_monitor)")
 DEF("enable_plan_cache", True, "bool",

@@ -48,6 +48,9 @@ class Database:
         self.ash = AshSampler(
             interval_s=int(self.config["ash_sample_interval_ms"]) / 1000.0)
         self.wait_events = WaitEvents()
+        # per-query spill records (feeds v$sql_workarea,
+        # ≙ the SQL memory manager's work-area profiles)
+        self.workarea_history: list[dict] = []
         self.virtual_tables = VirtualTables(self)
         if start_ash and self.config["enable_ash"]:
             self.ash.start()

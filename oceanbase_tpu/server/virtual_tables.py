@@ -37,6 +37,7 @@ class VirtualTables:
             "v$tables": self.tables,
             "v$palf": self.palf,
             "v$wait_events": self.wait_events,
+            "v$sql_workarea": self.sql_workarea,
             "v$errsim": self.errsim,
             "information_schema.tables": self.is_tables,
             "information_schema.columns": self.is_columns,
@@ -80,6 +81,23 @@ class VirtualTables:
             "session_id": np.array([x[1] for x in h], np.int64),
             "sql": _obj(x[2][:200] for x in h),
             "state": _obj(x[3] for x in h),
+        }
+
+    def sql_workarea(self):
+        """Spill activity per query (≙ GV$SQL_WORKAREA: the work-area
+        profile rows the SQL memory manager publishes)."""
+        recs = list(getattr(self.db, "workarea_history", []))[-1000:]
+        return {
+            "ts": np.array([r["ts"] for r in recs], np.float64),
+            "sql": _obj(r["sql"][:200] for r in recs),
+            "operation": _obj(r["kind"] for r in recs),
+            "spill_runs": np.array([r["runs"] for r in recs], np.int64),
+            "spill_bytes": np.array([r["bytes"] for r in recs], np.int64),
+            "spilled_rows": np.array([r["spilled_rows"] for r in recs],
+                                     np.int64),
+            "batches": np.array([r["batches"] for r in recs], np.int64),
+            "elapsed_s": np.array([r["elapsed_s"] for r in recs],
+                                  np.float64),
         }
 
     def parameters(self):

@@ -84,6 +84,7 @@ class Session:
             "autocommit": 1, "max_capacity_retry": self.MAX_CAPACITY_RETRIES,
         }
         self.plan_cache: dict[str, tuple] = {}
+        self._last_spill = None  # SpillStats of the last spilled query
         self._tx = None  # active explicit transaction (BEGIN ... COMMIT)
         self._ash_state = {"active": False, "sql": "", "state": "idle"}
         if db is not None:
@@ -652,6 +653,14 @@ class Session:
                 self._ash_state["sql"], stmt, params)
         else:
             plan, outputs, _est = self._plan_select(stmt, params)
+        # estimate-driven spill route (≙ the SQL memory manager deciding
+        # spill from work-area estimates BEFORE execution): over-budget
+        # inputs never materialize whole on device
+        big = self._spill_candidates(plan)
+        if big:
+            res = self._try_spilled(plan, outputs, big)
+            if res is not None:
+                return res
         tables = {t: self._table_snapshot(t)
                   for t in referenced_tables(plan)
                   if self.catalog.has_table(t)}
@@ -676,6 +685,13 @@ class Session:
                 break
             except CapacityOverflow:
                 if attempt >= int(self.variables["max_capacity_retry"]):
+                    # backstop: re-plan retries exhausted -> disk spill
+                    # tier, designating the largest input as the stream
+                    big = self._spill_candidates(plan, force_largest=True)
+                    res = (self._try_spilled(plan, outputs, big)
+                           if big else None)
+                    if res is not None:
+                        return res
                     raise
                 factor *= 4
                 if monitor is not None:
@@ -810,6 +826,122 @@ class Session:
         n = len(next(iter(arrays.values()))) if names else 0
         return Result(names, arrays, valids, dtypes, rowcount=n)
 
+    # ------------------------------------------------------------------
+    # disk spill tier (≙ SQL memory manager + spillable operators)
+    # ------------------------------------------------------------------
+    def _spill_candidates(self, plan, force_largest: bool = False) -> set:
+        """Engine-backed tables whose estimated live rows exceed the
+        work-area budget (sql_work_area_rows).  With force_largest (the
+        CapacityOverflow backstop) the largest table qualifies even under
+        budget — the plan overflowed regardless, so stream it."""
+        if self.db is None or self._tx is not None:
+            return set()
+        if not bool(self.db.config["enable_sql_spill"]):
+            return set()
+        from oceanbase_tpu.exec.plan import referenced_tables
+        from oceanbase_tpu.storage.lookup import estimate_rows_in_ranges
+
+        budget = int(self.db.config["sql_work_area_rows"])
+        est = {}
+        for t in referenced_tables(plan):
+            ts = self._engine.tables.get(t)
+            if ts is None:
+                continue
+            est[t] = estimate_rows_in_ranges(ts.tablet, {})
+        big = {t for t, e in est.items() if e > budget}
+        if not big and force_largest and est:
+            big = {max(est, key=est.get)}
+        return big
+
+    def _try_spilled(self, plan, outputs, big: set):
+        """Execute through exec/spill_exec (granule streams + temp-file
+        runs).  -> Result, or None when the plan shape is unsupported
+        (caller falls back to the in-memory engine)."""
+        import os
+        import uuid
+
+        from oceanbase_tpu.exec import spill_exec
+        from oceanbase_tpu.exec.plan import referenced_tables
+        from oceanbase_tpu.px.planner import NotDistributable
+
+        snap = self._txsvc.gts.current()
+        providers, types_by_table, device_tables = {}, {}, {}
+        for t in referenced_tables(plan):
+            ts = self._engine.tables.get(t)
+            if t in big and ts is not None:
+                providers[t] = self._spill_provider(ts.tablet, snap)
+                types_by_table[t] = {c.name: c.dtype
+                                     for c in ts.tdef.columns}
+            elif self.catalog.has_table(t):
+                device_tables[t] = self._table_snapshot(t)
+        if not providers:
+            return None
+        root = (self.db.root if self.db is not None and self.db.root
+                else None)
+        sdir = os.path.join(root or "/tmp/obtpu", "tmpfile",
+                            f"q{uuid.uuid4().hex[:10]}")
+        t0 = time.time()
+        try:
+            arrays, valids, dtypes, stats = spill_exec.execute_spilled(
+                plan, providers, sdir,
+                int(self.db.config["sql_work_area_rows"]),
+                device_tables, types_by_table, big)
+        except NotDistributable:
+            return None
+        self._last_spill = stats
+        self.db.workarea_history.append({
+            "ts": t0, "sql": self._ash_state.get("sql", ""),
+            "kind": stats.kind, "runs": stats.runs,
+            "bytes": stats.bytes, "spilled_rows": stats.spilled_rows,
+            "batches": stats.batches, "elapsed_s": time.time() - t0})
+        return self._materialize_host(arrays, valids, dtypes, outputs)
+
+    @staticmethod
+    def _spill_provider(tablet, snapshot: int):
+        """Chunk provider over one tablet (partitions chain in order)."""
+        from oceanbase_tpu.exec.granule import segment_chunk_provider
+
+        parts = getattr(tablet, "partitions", None)
+        if parts is None:
+            return segment_chunk_provider(tablet, snapshot)
+        provs = [segment_chunk_provider(p, snapshot) for p in parts]
+
+        def provider(table, chunk_rows, bounds=None):
+            for p in provs:
+                yield from p(table, chunk_rows, bounds)
+
+        return provider
+
+    def _materialize_host(self, arrays, valids, dtypes, outputs) -> Result:
+        """Result from host columns (the spill path's output boundary —
+        same shape contract as _materialize, minus the device hop)."""
+        names, out_a, out_v, out_t = [], {}, {}, {}
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        for cid, name in outputs:
+            out_name = name
+            k = 2
+            while out_name in out_a:
+                out_name = f"{name}_{k}"
+                k += 1
+            names.append(out_name)
+            a = arrays.get(cid)
+            if a is None:
+                a = np.zeros(n, dtype=np.int64)
+            out_a[out_name] = a
+            out_v[out_name] = valids.get(cid)
+            t = dtypes.get(cid)
+            if t is None:
+                if a.dtype == object or a.dtype.kind in "US":
+                    t = SqlType.string()
+                elif a.dtype.kind == "f":
+                    t = SqlType.double()
+                elif a.dtype.kind == "b":
+                    t = SqlType.bool_()
+                else:
+                    t = SqlType.int_()
+            out_t[out_name] = t
+        return Result(names, out_a, out_v, out_t, rowcount=n)
+
     def _explain(self, stmt, params, analyze: bool = False) -> Result:
         if not isinstance(stmt, ast.SelectStmt):
             raise NotImplementedError("EXPLAIN supports SELECT")
@@ -820,19 +952,32 @@ class Session:
                         sysvars=self.variables)
         plan, outputs, est = binder.bind_select(stmt)
         row_counts = None
+        spill_line = ""
         if analyze:
             from oceanbase_tpu.exec.plan import referenced_tables
 
-            tables = {t: self._table_snapshot(t)
-                      for t in referenced_tables(plan)
-                      if self.catalog.has_table(t)}
-            monitor: list = []
-            execute_plan(plan, tables, monitor_out=monitor)
-            # monitor entries arrive in the executor's postorder; map them
-            # back to nodes for annotation
-            row_counts = dict(zip(_postorder_ids(plan),
-                                  (cnt for _n, cnt in monitor)))
-        text = format_plan(plan, row_counts=row_counts)
+            # over-budget inputs run through the spill tier (running the
+            # in-memory path here would hit the very overflow the route
+            # exists to avoid); the spill counters annotate the plan
+            big = self._spill_candidates(plan)
+            res = self._try_spilled(plan, outputs, big) if big else None
+            if res is not None:
+                s = self._last_spill
+                spill_line = (f"\nspill: kind={s.kind} runs={s.runs} "
+                              f"bytes={s.bytes} "
+                              f"spilled_rows={s.spilled_rows} "
+                              f"batches={s.batches}")
+            else:
+                tables = {t: self._table_snapshot(t)
+                          for t in referenced_tables(plan)
+                          if self.catalog.has_table(t)}
+                monitor: list = []
+                execute_plan(plan, tables, monitor_out=monitor)
+                # monitor entries arrive in the executor's postorder; map
+                # them back to nodes for annotation
+                row_counts = dict(zip(_postorder_ids(plan),
+                                      (cnt for _n, cnt in monitor)))
+        text = format_plan(plan, row_counts=row_counts) + spill_line
         # access-path annotations (≙ the 'Outputs & filters ... access'
         # section of the reference's EXPLAIN)
         if self.db is not None:

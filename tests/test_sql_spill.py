@@ -1,0 +1,162 @@
+"""SQL-level spill tier: over-budget queries complete through the disk
+tier with spill counters visible (VERDICT r2 item #2).
+
+≙ src/sql/engine/ob_tenant_sql_memory_manager.h (spill decision) +
+ob_sort_vec_op.h / ob_hash_join_vec_op.h:413 (spilling operators).
+"""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.server import Database
+
+N = 40_000  # rows; budget drops to 4096 so these are ~10x over budget
+
+
+def _mk(tmp_path, budget=4096):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute(f"alter system set sql_work_area_rows = {budget}")
+    return db, s
+
+
+def _load_big(s, name="t", n=N, seed=1):
+    rng = np.random.default_rng(seed)
+    k = np.arange(n)
+    v = rng.integers(0, 1_000_000, n)
+    g = rng.integers(0, n // 2, n)  # high NDV for group-by
+    s.execute(f"create table {name} "
+              f"(k int primary key, v int, g int)")
+    rows = ", ".join(f"({k[i]}, {v[i]}, {g[i]})" for i in range(n))
+    s.execute(f"insert into {name} values {rows}")
+    return k, v, g
+
+
+def test_order_by_over_budget_spills_and_completes(tmp_path):
+    db, s = _mk(tmp_path)
+    _k, v, _g = _load_big(s)
+    r = s.execute("select k, v from t order by v, k limit 20")
+    got = r.rows()
+    order = np.lexsort((np.arange(N), v))
+    exp = [(int(order[i]), int(v[order[i]])) for i in range(20)]
+    assert got == exp
+    st = s._last_spill
+    assert st is not None and st.kind.startswith("sort")
+    assert st.runs > 0 and st.bytes > 0 and st.spilled_rows > 0
+    db.close()
+
+
+def test_group_by_over_budget_spills_with_parity(tmp_path):
+    db, s = _mk(tmp_path)
+    _k, v, g = _load_big(s)
+    r = s.execute("select g, count(*) as c, sum(v) as sv, min(v) as mn "
+                  "from t group by g order by g")
+    got = r.rows()
+    exp = {}
+    for gi, vi in zip(g.tolist(), v.tolist()):
+        c, sv, mn = exp.get(gi, (0, 0, None))
+        exp[gi] = (c + 1, sv + vi, vi if mn is None else min(mn, vi))
+    assert len(got) == len(exp)
+    for gi, c, sv, mn in got:
+        ec, esv, emn = exp[gi]
+        assert (c, sv, mn) == (ec, esv, emn)
+    assert s._last_spill is not None
+    assert "groupby" in s._last_spill.kind
+    db.close()
+
+
+def test_scalar_agg_over_budget_streams(tmp_path):
+    db, s = _mk(tmp_path)
+    _k, v, _g = _load_big(s)
+    r = s.execute("select count(*), sum(v), avg(v), max(v) from t")
+    cnt, sv, av, mx = r.rows()[0]
+    assert cnt == N and sv == int(v.sum()) and mx == int(v.max())
+    assert abs(av - v.mean()) < 1.0
+    assert s._last_spill is not None and "scalar" in s._last_spill.kind
+    db.close()
+
+
+def test_join_big_probe_small_build_spills(tmp_path):
+    db, s = _mk(tmp_path)
+    _k, v, g = _load_big(s)
+    s.execute("create table d (g int primary key, name varchar(16))")
+    rows = ", ".join(f"({i}, 'n{i % 7}')" for i in range(0, N // 2, 16))
+    s.execute(f"insert into d values {rows}")
+    r = s.execute("select d.name as name, count(*) as c, sum(t.v) as sv "
+                  "from t join d on t.g = d.g "
+                  "group by d.name order by name")
+    got = r.rows()
+    dset = {i: f"n{i % 7}" for i in range(0, N // 2, 16)}
+    exp = {}
+    for gi, vi in zip(g.tolist(), v.tolist()):
+        nm = dset.get(gi)
+        if nm is None:
+            continue
+        c, sv = exp.get(nm, (0, 0))
+        exp[nm] = (c + 1, sv + vi)
+    assert got == [(nm, exp[nm][0], exp[nm][1]) for nm in sorted(exp)]
+    st = s._last_spill
+    assert st is not None and "join" in st.kind
+    db.close()
+
+
+def test_join_both_sides_over_budget_copartitions(tmp_path):
+    db, s = _mk(tmp_path)
+    n = 20_000
+    rng = np.random.default_rng(5)
+    a_v = rng.integers(0, 100, n)
+    s.execute("create table a (k int primary key, j int, v int)")
+    s.execute("insert into a values " + ", ".join(
+        f"({i}, {i % (n // 4)}, {a_v[i]})" for i in range(n)))
+    s.execute("create table b (k int primary key, j int, w int)")
+    s.execute("insert into b values " + ", ".join(
+        f"({i}, {i % (n // 4)}, {i % 13})" for i in range(n)))
+    r = s.execute("select count(*) as c, sum(a.v + b.w) as sv "
+                  "from a join b on a.j = b.j")
+    cnt, sv = r.rows()[0]
+    # each j value appears 4x in each table -> 16 pairs per j
+    assert cnt == 16 * (n // 4)
+    exp = 0
+    for i in range(n):
+        for m in range(i % (n // 4), n, n // 4):
+            exp += int(a_v[i]) + (m % 13)
+    assert sv == exp
+    st = s._last_spill
+    assert st is not None and st.spilled_rows > 0
+    db.close()
+
+
+def test_spill_counters_in_vsql_workarea_and_explain(tmp_path):
+    db, s = _mk(tmp_path)
+    _load_big(s)
+    s.execute("select k from t order by v limit 5")
+    r = s.execute("select operation, spill_runs, spill_bytes "
+                  "from v$sql_workarea")
+    rows = r.rows()
+    assert rows and any(op.startswith("sort") and runs > 0 and b > 0
+                        for op, runs, b in rows)
+    r = s.execute("explain analyze select k from t order by v limit 5")
+    assert "spill:" in r.plan_text
+    db.close()
+
+
+def test_under_budget_queries_do_not_spill(tmp_path):
+    db, s = _mk(tmp_path, budget=1 << 22)
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values " + ", ".join(
+        f"({i}, {i * 3})" for i in range(500)))
+    r = s.execute("select k from t order by v desc limit 3")
+    assert r.rows() == [(499,), (498,), (497,)]
+    assert s._last_spill is None
+    db.close()
+
+
+def test_spill_disabled_falls_back(tmp_path):
+    db, s = _mk(tmp_path, budget=4096)
+    s.execute("alter system set enable_sql_spill = false")
+    _load_big(s, n=8192)
+    # in-memory path must still answer (8k rows fit on device fine)
+    r = s.execute("select count(*) from t")
+    assert r.rows()[0][0] == 8192
+    assert s._last_spill is None
+    db.close()
