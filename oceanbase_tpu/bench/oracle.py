@@ -38,6 +38,15 @@ def load_sqlite(tables: dict, types: dict) -> sqlite3.Connection:
         rows = list(zip(*pycols))
         ph = ",".join("?" * len(colnames))
         conn.executemany(f"insert into {name} values ({ph})", rows)
+    # index every *key column (PKs and FKs) so correlated subqueries and
+    # joins in the ORACLE don't go quadratic at SF>=0.1 — the oracle's
+    # job is to be correct AND fast enough to produce SF1 evidence
+    for name, cols in tables.items():
+        for c in cols:
+            if c.endswith("key"):
+                conn.execute(
+                    f"create index idx_{name}_{c} on {name} ({c})")
+    conn.execute("analyze")
     conn.commit()
     return conn
 

@@ -338,9 +338,15 @@ def _stream_join(ctx: _Ctx, node: pp.HashJoin):
             srel = _pad_to_relation(ctx, arrays, valids)
             n = len(next(iter(arrays.values())))
             # per-batch output budget scales with the batch, not the
-            # planner's whole-query estimate
-            cap = max(node.out_capacity or 0, 2 * n, 1024)
-            for _attempt in range(4):
+            # planner's whole-query estimate; the x4 retry loop recovers
+            # from underestimates, and the LAST attempt falls back to the
+            # planner's whole-query estimate so extreme per-key fanout
+            # (>128x batch rows) still completes instead of erroring
+            cap = max(2 * n, 1024)
+            last = max(cap * 4 ** 4, node.out_capacity or 0)
+            for _attempt in range(5):
+                if _attempt == 4:
+                    cap = last
                 with diag.collect() as entries:
                     if lbig:
                         j = ops.join(srel, build_rel, skeys, bkeys,

@@ -1,0 +1,19 @@
+"""Host RPC plane for multi-node operation.
+
+Reference analog: the rpc frame (deps/oblib/src/rpc — obrpc proxy codegen
+over libeasy/pnio reactors) carrying PALF replication
+(src/logservice/palf/palf_handle_impl.cpp:3235 receive_log), DAS remote
+table access (src/sql/das/ob_data_access_service.h:21), and the location
+service (src/share/location_cache/ob_location_service.h:27).
+
+TPU-first split: the COMPUTE plane stays XLA collectives over ICI (px/);
+this package is the HOST control/data plane between OS processes — python
+sockets + a binary column codec stand in for obrpc, carrying redo logs,
+snapshot scans, and SQL routing between nodes.
+"""
+
+from oceanbase_tpu.net.codec import decode_msg, encode_msg
+from oceanbase_tpu.net.rpc import RpcClient, RpcError, RpcServer
+
+__all__ = ["encode_msg", "decode_msg", "RpcServer", "RpcClient",
+           "RpcError"]

@@ -1,0 +1,177 @@
+"""Length-prefixed TCP RPC: threaded server + pooled client.
+
+Reference analog: the rpc frame (deps/oblib/src/rpc/frame,
+ObReqTransport + macro-generated ObRpcProxy stubs).  Here: one TCP
+connection per client, u32-framed codec messages, a method-name
+dispatch table on the server, synchronous request/response.
+
+Request body:  {"method": str, "params": {...}, "rid": int}
+Response body: {"rid": int, "ok": bool, "result": ... | "error": str}
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import socketserver
+import struct
+import threading
+
+from oceanbase_tpu.net.codec import decode_msg, encode_msg
+
+_U32 = struct.Struct("<I")
+MAX_MSG = 1 << 30
+
+
+class RpcError(RuntimeError):
+    """Remote handler raised; .kind carries the remote exception type."""
+
+    def __init__(self, kind: str, msg: str):
+        super().__init__(f"{kind}: {msg}")
+        self.kind = kind
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    chunks = []
+    while n > 0:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            return None
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _send_frame(sock: socket.socket, payload: bytes):
+    sock.sendall(_U32.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> bytes | None:
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = _U32.unpack(hdr)
+    if n > MAX_MSG:
+        raise RpcError("Protocol", f"frame too large: {n}")
+    return _recv_exact(sock, n)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                frame = _recv_frame(self.request)
+            except (ConnectionError, OSError):
+                return
+            if frame is None:
+                return
+            msg = decode_msg(frame)
+            rid = msg.get("rid", 0)
+            fn = self.server.handlers.get(msg.get("method"))
+            if fn is None:
+                resp = {"rid": rid, "ok": False,
+                        "error_kind": "NoSuchMethod",
+                        "error": str(msg.get("method"))}
+            else:
+                try:
+                    result = fn(**(msg.get("params") or {}))
+                    resp = {"rid": rid, "ok": True, "result": result}
+                except Exception as e:  # noqa: BLE001 — ship to caller
+                    resp = {"rid": rid, "ok": False,
+                            "error_kind": type(e).__name__,
+                            "error": str(e)}
+            try:
+                _send_frame(self.request, encode_msg(resp))
+            except (ConnectionError, OSError):
+                return
+
+
+class RpcServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host: str, port: int, handlers: dict):
+        super().__init__((host, port), _Handler)
+        self.handlers = dict(handlers)
+        self._thread: threading.Thread | None = None
+
+    def register(self, name: str, fn):
+        self.handlers[name] = fn
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.shutdown()
+        self.server_close()
+
+
+class RpcClient:
+    """One connection, lazily (re)established; thread-safe via a lock
+    (requests serialize per connection — fine for the host control
+    plane; PX data stays on ICI collectives)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self.addr = (host, port)
+        self.timeout_s = timeout_s
+        self._sock: socket.socket | None = None
+        self._rid = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        s = socket.create_connection(self.addr, timeout=self.timeout_s)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+
+    def call(self, method: str, **params):
+        with self._lock:
+            req = encode_msg({"method": method, "params": params,
+                              "rid": next(self._rid)})
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._connect()
+                try:
+                    _send_frame(self._sock, req)
+                except (ConnectionError, OSError):
+                    # send failed -> the handler cannot have run; a stale
+                    # pooled connection is the common cause, reconnect once
+                    self.close()
+                    if attempt:
+                        raise
+                    continue
+                try:
+                    frame = _recv_frame(self._sock)
+                except (ConnectionError, OSError):
+                    # the request MAY have executed remotely — never
+                    # resend non-idempotent work; surface the failure
+                    self.close()
+                    raise
+                break
+            if frame is None:
+                self.close()
+                raise ConnectionError(f"peer {self.addr} closed")
+            resp = decode_msg(frame)
+            if not resp.get("ok"):
+                raise RpcError(resp.get("error_kind", "Remote"),
+                               resp.get("error", ""))
+            return resp.get("result")
+
+    def ping(self) -> bool:
+        try:
+            return self.call("ping") == "pong"
+        except (OSError, RpcError):
+            return False
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None

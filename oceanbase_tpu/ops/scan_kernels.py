@@ -41,11 +41,25 @@ def _q6_kernel(ship_ref, disc_ref, qty_ref, price_ref, live_ref,
     mask = ((ship >= ship_lo) & (ship < ship_hi)
             & (disc >= disc_lo) & (disc <= disc_hi)
             & (qty < qty_hi) & (live != 0))
-    prod = jnp.where(mask, price * disc, 0)
+    prod = price * disc * mask.astype(jnp.int32)
     hi = prod >> 16
     lo = prod & 0xFFFF
-    hi_ref[0, 0] = jnp.sum(hi)
-    lo_ref[0, 0] = jnp.sum(lo)
+    # whole-array output block (Mosaic rejects (1,1) VMEM tiles); each
+    # grid step owns one row of the partials array
+    i = pl.program_id(0)
+    # Reduce ONLY over sublanes in-kernel (axis 0), emitting one
+    # 128-lane partial row per block; the final cross-lane reduction
+    # runs outside the kernel in int64 XLA.  Two reasons, both Mosaic:
+    # scalar-output reductions proxy through jnp.sum (which inserts an
+    # int32->int64 convert under jax_enable_x64 that Mosaic won't
+    # lower), and a lane-shaped store keeps the output VMEM-tileable.
+    # reduce_sum_p is bound directly so the accumulator stays int32.
+    # Bounds: sum over 64 sublanes of hi<=2^15 -> 2^21; lo<=0xFFFF ->
+    # 2^22 — no int32 overflow.
+    hsum = jax.lax.reduce_sum_p.bind(hi, axes=(0,))     # (128,)
+    lsum = jax.lax.reduce_sum_p.bind(lo, axes=(0,))
+    hi_ref[pl.dslice(i, 1), :] = hsum.reshape(1, _LANE)
+    lo_ref[pl.dslice(i, 1), :] = lsum.reshape(1, _LANE)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -79,18 +93,30 @@ def q6_filter_sum(shipdate, discount, quantity, extendedprice, live,
         _q6_kernel, ship_lo=ship_lo, ship_hi=ship_hi,
         disc_lo=disc_lo, disc_hi=disc_hi, qty_hi=qty_hi)
 
-    blk = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0),
-                       memory_space=pltpu.VMEM)
-    out_blk = pl.BlockSpec((1, 1), lambda i: (i, 0),
+    # The whole (chunk_blocks, 128) partials array stays VMEM-resident
+    # for one pallas_call (the constant-index-map out spec), so bound it:
+    # chunks of <= MAX_BLOCKS blocks (~1 MB of int32 partials) keep VMEM
+    # flat no matter the input size; the int64 combine runs per chunk in
+    # plain XLA.  (A (1,128) per-step out block would be ideal but Mosaic
+    # requires the trailing block dims divisible by (8,128) or whole.)
+    MAX_BLOCKS = 1024  # 8.4M rows per call
+    total = jnp.zeros((), jnp.int64)
+    for s in range(0, nblocks, MAX_BLOCKS):
+        nb = min(MAX_BLOCKS, nblocks - s)
+        rows = slice(s * _SUB, (s + nb) * _SUB)
+        blk = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0),
                            memory_space=pltpu.VMEM)
-    hi, lo = pl.pallas_call(
-        kernel,
-        grid=(nblocks,),
-        in_specs=[blk, blk, blk, blk, blk],
-        out_specs=(out_blk, out_blk),
-        out_shape=(jax.ShapeDtypeStruct((nblocks, 1), jnp.int32),
-                   jax.ShapeDtypeStruct((nblocks, 1), jnp.int32)),
-        interpret=interpret,
-    )(ship, disc, qty, price, lv)
-    return (jnp.sum(hi.astype(jnp.int64)) << 16) + \
-        jnp.sum(lo.astype(jnp.int64))
+        out_blk = pl.BlockSpec((nb, _LANE), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)
+        hi, lo = pl.pallas_call(
+            kernel,
+            grid=(nb,),
+            in_specs=[blk, blk, blk, blk, blk],
+            out_specs=(out_blk, out_blk),
+            out_shape=(jax.ShapeDtypeStruct((nb, _LANE), jnp.int32),
+                       jax.ShapeDtypeStruct((nb, _LANE), jnp.int32)),
+            interpret=interpret,
+        )(ship[rows], disc[rows], qty[rows], price[rows], lv[rows])
+        total = total + (jnp.sum(hi.astype(jnp.int64)) << 16) + \
+            jnp.sum(lo.astype(jnp.int64))
+    return total

@@ -444,7 +444,8 @@ class Binder:
             eqs, lpreds, rpreds, residual = self._split_on(on, lf, rf, scope)
         for p in rpreds:
             rf = Fragment(pp.Filter(rf.plan, p), rf.cols,
-                          max(1, rf.est_rows // 3), rf.unique_cols)
+                          max(1, rf.est_rows // 3), rf.unique_cols,
+                          colids=rf.colids, ndv=rf.ndv)
         lkeys = [e[0] for e in eqs]
         rkeys = [e[1] for e in eqs]
         cap = _pow2(int(lf.est_rows * 1.5) + 16)
@@ -459,7 +460,8 @@ class Binder:
         merged_cols = {**lf.cols, **rf.cols}
         qb.fragments.append(Fragment(plan, merged_cols, lf.est_rows,
                                      lf.unique_cols,
-                                     colids=lf.colids | rf.colids))
+                                     colids=lf.colids | rf.colids,
+                                     ndv={**lf.ndv, **rf.ndv}))
 
     def _bind_side(self, tref, scope: Scope) -> Fragment:
         """Bind one side of an eager (outer) join into a single fragment."""
@@ -476,11 +478,13 @@ class Binder:
         cols = {}
         colids = frozenset()
         unique = frozenset()
+        ndv = {}
         for f in sub_qb.fragments:
             cols.update(f.cols)
             colids |= f.colids
             unique |= f.unique_cols
-        return Fragment(plan, cols, est, unique, colids=colids)
+            ndv.update(f.ndv)
+        return Fragment(plan, cols, est, unique, colids=colids, ndv=ndv)
 
     @staticmethod
     def _col_in(frag: Fragment, name: str) -> str:
@@ -539,7 +543,7 @@ class Binder:
 
     # ------------------------------------------------------------------
     def _bind_where(self, where: ir.Expr, qb: QueryBlock, scope: Scope):
-        for conj in _conjuncts(where):
+        for conj in _conjuncts(factor_or_common(where)):
             self._bind_conjunct(conj, qb, scope)
 
     def _bind_conjunct(self, conj, qb: QueryBlock, scope: Scope):
@@ -572,7 +576,7 @@ class Binder:
                 qb.fragments[i] = Fragment(
                     pp.Filter(f.plan, bound), f.cols,
                     max(1, int(f.est_rows * _selectivity(bound))),
-                    f.unique_cols,
+                    f.unique_cols, colids=f.colids, ndv=f.ndv,
                 )
             else:
                 qb.post_preds.append(bound)  # constant predicate
@@ -648,7 +652,8 @@ class Binder:
             new_plan = pp.HashJoin(f.plan, in_plan, lhs_exprs, rkeys,
                                    how=how, out_capacity=cap)
         est = max(1, f.est_rows // (2 if not anti else 3))
-        qb.fragments[i] = Fragment(new_plan, f.cols, est, f.unique_cols)
+        qb.fragments[i] = Fragment(new_plan, f.cols, est, f.unique_cols,
+                                   colids=f.colids, ndv=f.ndv)
 
     def _rewrite_scalar_cmp(self, conj, sub, other_side, sub_on_left, qb,
                             scope):
@@ -725,9 +730,19 @@ class Binder:
         if having_bound is not None:
             having_bound = replace(having_bound)
 
+        # NDV-driven key-cardinality estimate (≙ ObOptEstCost group-by
+        # cardinality from basic stats): a plain column key with known
+        # NDV contributes its NDV; derived keys fall back to 32
+        ndv_by_cid = {}
+        for f in qb.fragments:
+            ndv_by_cid.update(f.ndv)
         n_keys_est = 1
         for b in key_map.values():
-            n_keys_est *= 32
+            if isinstance(b, ir.ColumnRef) and b.name in ndv_by_cid:
+                n_keys_est *= max(1, ndv_by_cid[b.name])
+            else:
+                n_keys_est *= 32
+            n_keys_est = min(n_keys_est, 1 << 40)  # overflow guard
         out_cap = _pow2(min(est, max(64, min(n_keys_est, est))))
         if key_map:
             plan = pp.GroupBy(plan, key_map, agg_specs, out_capacity=out_cap)
@@ -979,6 +994,69 @@ def _conjuncts(e: ir.Expr):
         yield e
 
 
+def _expr_key(e):
+    """Structural identity key for unbound predicate trees (ir nodes use
+    identity equality).  Unknown node kinds key on object identity so
+    factoring never produces a false positive."""
+    if isinstance(e, ir.ColumnRef):
+        return ("col", e.name)
+    if isinstance(e, ir.Literal):
+        return ("lit", repr(e.value), repr(e.dtype))
+    if isinstance(e, (ir.Cmp, ir.Arith)):
+        return (type(e).__name__, e.op, _expr_key(e.left),
+                _expr_key(e.right))
+    if isinstance(e, ir.Logic):
+        return ("logic", e.op, tuple(_expr_key(a) for a in e.args))
+    if isinstance(e, ir.Not):
+        return ("not", _expr_key(e.arg))
+    if isinstance(e, ir.InList):
+        return ("in", e.negated, _expr_key(e.arg),
+                tuple(_expr_key(v) for v in e.values))
+    return ("id", id(e))
+
+
+def _and_of(conjs: list):
+    return conjs[0] if len(conjs) == 1 else ir.Logic("and", conjs)
+
+
+def factor_or_common(e):
+    """(A and X) or (A and Y)  ->  A and (X or Y).
+
+    Hoists conjuncts common to EVERY branch of a disjunction, so
+    equi-join keys buried inside OR branches (TPC-H Q19's
+    p_partkey = l_partkey) still become join edges instead of forcing a
+    cross join.  ≙ common-predicate extraction in the rewriter
+    (src/sql/rewrite/ob_transform_predicate_move_around.h).
+    """
+    if isinstance(e, ir.Not):
+        return ir.Not(factor_or_common(e.arg))
+    if not isinstance(e, ir.Logic):
+        return e
+    args = [factor_or_common(a) for a in e.args]
+    if e.op != "or" or len(args) < 2:
+        return ir.Logic(e.op, args)
+    branches = [list(_conjuncts(a)) for a in args]
+    keysets = [{_expr_key(c) for c in bs} for bs in branches]
+    common_keys = set.intersection(*keysets)
+    if not common_keys:
+        return ir.Logic("or", args)
+    common, seen = [], set()
+    for c in branches[0]:
+        k = _expr_key(c)
+        if k in common_keys and k not in seen:
+            seen.add(k)
+            common.append(c)
+    rests = []
+    for bs in branches:
+        rest = [c for c in bs if _expr_key(c) not in common_keys]
+        if not rest:
+            # a branch reduced to exactly the common part:
+            # (A) or (A and X) == A
+            return _and_of(common)
+        rests.append(_and_of(rest))
+    return _and_of(common + [ir.Logic("or", rests)])
+
+
 def _find_subquery(e: ir.Expr):
     if isinstance(e, ast.Subquery):
         return e
@@ -1132,6 +1210,7 @@ def _bind_conjunct_bound(self: Binder, bound: ir.Expr, qb: QueryBlock):
         qb.fragments[i] = Fragment(
             pp.Filter(f.plan, bound), f.cols,
             max(1, int(f.est_rows * _selectivity(bound))), f.unique_cols,
+            colids=f.colids, ndv=f.ndv,
         )
     else:
         qb.post_preds.append(bound)

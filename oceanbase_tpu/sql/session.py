@@ -830,24 +830,60 @@ class Session:
     # disk spill tier (≙ SQL memory manager + spillable operators)
     # ------------------------------------------------------------------
     def _spill_candidates(self, plan, force_largest: bool = False) -> set:
-        """Engine-backed tables whose estimated live rows exceed the
-        work-area budget (sql_work_area_rows).  With force_largest (the
-        CapacityOverflow backstop) the largest table qualifies even under
-        budget — the plan overflowed regardless, so stream it."""
-        if self.db is None or self._tx is not None:
+        """Tables whose estimated rows REACHING the plan exceed the
+        work-area budget (sql_work_area_rows).  The estimate is
+        post-access-path (≙ deciding spill from per-operator work-area
+        estimates, not base-table size): a table whose filter conjuncts
+        admit a selective primary/secondary path keeps the in-memory
+        index fast-path even when the raw table is over budget.  With
+        force_largest (the CapacityOverflow backstop) the largest table
+        qualifies even under budget — the plan overflowed regardless, so
+        stream it."""
+        if self.db is None:
             return set()
         if not bool(self.db.config["enable_sql_spill"]):
             return set()
         from oceanbase_tpu.exec.plan import referenced_tables
+        from oceanbase_tpu.sql import access_path as ap
         from oceanbase_tpu.storage.lookup import estimate_rows_in_ranges
 
+        refs = list(referenced_tables(plan))
+        if self._tx is not None:
+            # spill streams read committed state at a snapshot; a table
+            # this tx has written must come from the own-writes read
+            # path, so stay in-memory when any referenced table is dirty
+            if any(t in self._tx.participants for t in refs):
+                return set()
         budget = int(self.db.config["sql_work_area_rows"])
+        try:
+            ranges_by_table = ap.scan_filter_ranges(plan, self._engine)
+        except Exception:
+            ranges_by_table = {}
         est = {}
-        for t in referenced_tables(plan):
+        for t in refs:
             ts = self._engine.tables.get(t)
             if ts is None:
+                # catalog-only relation (load_numpy/transient): spill can
+                # still stream it chunk-wise to bound intermediates
+                if self.catalog.has_table(t):
+                    try:
+                        rel = self.catalog.table_data(t)
+                    except KeyError:
+                        continue
+                    # live rows, not pow2-padded capacity — padding alone
+                    # must not route a fitting query to the disk tier
+                    if rel.mask is None:
+                        est[t] = rel.capacity
+                    else:
+                        est[t] = int(np.asarray(rel.mask).sum())
                 continue
-            est[t] = estimate_rows_in_ranges(ts.tablet, {})
+            rngs = ranges_by_table.get(t) or {}
+            choice = ap.choose_path(self._engine, t, rngs) if rngs \
+                else None
+            if choice is not None:
+                est[t] = choice.est_rows
+            else:
+                est[t] = estimate_rows_in_ranges(ts.tablet, rngs)
         big = {t for t, e in est.items() if e > budget}
         if not big and force_largest and est:
             big = {max(est, key=est.get)}
@@ -864,7 +900,13 @@ class Session:
         from oceanbase_tpu.exec.plan import referenced_tables
         from oceanbase_tpu.px.planner import NotDistributable
 
-        snap = self._txsvc.gts.current()
+        # ONE read point for every table in the query (big streams and
+        # small device relations alike) — a commit landing mid-query must
+        # not split the snapshot across joined tables.  Inside an explicit
+        # transaction the read point is the tx begin-snapshot
+        # (_spill_candidates already excluded tables the tx wrote).
+        snap = (self._tx.snapshot if self._tx is not None
+                else self._txsvc.gts.current())
         providers, types_by_table, device_tables = {}, {}, {}
         for t in referenced_tables(plan):
             ts = self._engine.tables.get(t)
@@ -872,6 +914,13 @@ class Session:
                 providers[t] = self._spill_provider(ts.tablet, snap)
                 types_by_table[t] = {c.name: c.dtype
                                      for c in ts.tdef.columns}
+            elif t in big and self.catalog.has_table(t):
+                providers[t] = self._catalog_provider(t)
+                types_by_table[t] = {
+                    c.name: c.dtype
+                    for c in self.catalog.table_def(t).columns}
+            elif ts is not None:
+                device_tables[t] = self.catalog.table_data_at(t, snap)
             elif self.catalog.has_table(t):
                 device_tables[t] = self._table_snapshot(t)
         if not providers:
@@ -895,6 +944,20 @@ class Session:
             "bytes": stats.bytes, "spilled_rows": stats.spilled_rows,
             "batches": stats.batches, "elapsed_s": time.time() - t0})
         return self._materialize_host(arrays, valids, dtypes, outputs)
+
+    def _catalog_provider(self, name: str):
+        """Chunk provider over a catalog-only relation (load_numpy /
+        transient): decode to host once, stream in slices so plan
+        intermediates stay inside the work-area budget."""
+        from oceanbase_tpu.exec.granule import numpy_chunk_provider
+        from oceanbase_tpu.vector import to_numpy
+
+        raw = to_numpy(self.catalog.table_data(name))
+        arrays = {k: v for k, v in raw.items()
+                  if not k.startswith("__valid__")}
+        valids = {k[len("__valid__"):]: v for k, v in raw.items()
+                  if k.startswith("__valid__")}
+        return numpy_chunk_provider(arrays, valids)
 
     @staticmethod
     def _spill_provider(tablet, snapshot: int):
@@ -926,7 +989,17 @@ class Session:
             names.append(out_name)
             a = arrays.get(cid)
             if a is None:
-                a = np.zeros(n, dtype=np.int64)
+                if n == 0:
+                    # legitimately empty spilled result: no batches
+                    # survived, so no columns materialized at all
+                    a = np.zeros(0, dtype=np.int64)
+                else:
+                    # a dropped output column with rows present is a
+                    # planner/spill bug — surface it (the in-memory
+                    # _materialize would KeyError here too)
+                    raise KeyError(
+                        f"spill result missing output column {cid} "
+                        f"({name})")
             out_a[out_name] = a
             out_v[out_name] = valids.get(cid)
             t = dtypes.get(cid)

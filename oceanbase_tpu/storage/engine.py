@@ -801,8 +801,8 @@ class StorageCatalog(Catalog):
             return rel
 
     def table_data_at(self, name, snapshot: int, tx_id: int = 0):
-        """Uncached snapshot read at an explicit version (+ own-tx writes)
-        — the read path active transactions use."""
+        """Snapshot read at an explicit version (+ own-tx writes) — the
+        read path active transactions use."""
         from oceanbase_tpu.vector import from_numpy
 
         with self._lock:
@@ -812,6 +812,15 @@ class StorageCatalog(Catalog):
         if t is not None:
             return t[1]
         ts = self.engine.tables[name]
+        if tx_id == 0 and snapshot >= ts.tablet.max_commit_version():
+            # no committed version is newer than the snapshot, so the
+            # latest-commit read (which caches its device relation) sees
+            # identical data — reuse it instead of re-decoding.  Re-check
+            # after materializing: a commit landing mid-read would make
+            # the latest view newer than the snapshot.
+            rel = self.table_data(name)
+            if snapshot >= ts.tablet.max_commit_version():
+                return rel
         arrays, valids = ts.tablet.snapshot_arrays(snapshot, tx_id)
         n = len(next(iter(arrays.values()))) if arrays else 0
         if n == 0:

@@ -69,6 +69,10 @@ class PartitionedTablet:
             out.extend(p.frozen[::-1])
         return out
 
+    def max_commit_version(self) -> int:
+        return max((p.max_commit_version() for p in self.partitions),
+                   default=0)
+
     @property
     def frozen(self):
         out = []

@@ -292,6 +292,14 @@ class Tablet:
         """-> [(Segment, partition_idx|None)] for manifest checkpoints."""
         return [(s, None) for s in self.segments]
 
+    def max_commit_version(self) -> int:
+        """Largest commit version any row in this tablet carries; a read
+        at snapshot >= this sees the same data as a latest-commit read."""
+        v = max((s.max_version for s in self.segments), default=0)
+        for mt in [self.active] + self.frozen:
+            v = max(v, mt.max_version)
+        return v
+
 
 def _rows_to_arrays(rows: dict, columns, types):
     n = len(rows)

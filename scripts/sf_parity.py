@@ -1,0 +1,111 @@
+"""SF1 TPC-H 22-query parity evidence runner (VERDICT r3 item #2).
+
+Generates TPC-H at SF (env PARITY_SF, default 1.0), loads both the
+engine and the (now indexed) SQLite oracle, runs all 22 queries through
+each, diffs results, and writes SF1_PARITY.json with per-query engine
+and oracle wall times plus row counts — an artifact a skeptic can check.
+
+Usage: [PARITY_SF=1.0] python scripts/sf_parity.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# force CPU: the environment pins JAX_PLATFORMS to the (possibly dead)
+# axon TPU tunnel, which would wedge jax initialization
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+# the axon sitecustomize registers + pins the TPU relay backend in every
+# interpreter; drop it before any backend is instantiated (as conftest does)
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from oceanbase_tpu.bench.oracle import (  # noqa: E402
+    load_sqlite, rows_match, run_oracle)
+from oceanbase_tpu.bench.tpch import (  # noqa: E402
+    TPCH_PRIMARY_KEYS, gen_tpch)
+from oceanbase_tpu.bench.tpch_queries import QUERIES  # noqa: E402
+from oceanbase_tpu.sql import Session  # noqa: E402
+
+SF = float(os.environ.get("PARITY_SF", "1.0"))
+OUT = os.path.join(os.path.dirname(__file__), "..",
+                   os.environ.get("PARITY_OUT", "SF1_PARITY.json"))
+
+
+def main():
+    t0 = time.time()
+    print(f"generating TPC-H SF={SF} ...", flush=True)
+    tables, types = gen_tpch(sf=SF)
+    gen_s = time.time() - t0
+    print(f"  done in {gen_s:.1f}s "
+          f"(lineitem={len(tables['lineitem']['l_orderkey'])} rows)",
+          flush=True)
+
+    sess = Session()
+    t0 = time.time()
+    for name, arrays in tables.items():
+        sess.catalog.load_numpy(
+            name, arrays,
+            types={k: v for k, v in types.items() if k in arrays},
+            primary_key=TPCH_PRIMARY_KEYS[name])
+    load_engine_s = time.time() - t0
+    t0 = time.time()
+    conn = load_sqlite(tables, types)
+    load_oracle_s = time.time() - t0
+    print(f"loads: engine {load_engine_s:.1f}s, "
+          f"oracle {load_oracle_s:.1f}s", flush=True)
+
+    results = {}
+    n_ok = 0
+    for qnum in sorted(QUERIES):
+        sql = QUERIES[qnum]
+        t0 = time.time()
+        want = run_oracle(conn, sql)
+        oracle_s = time.time() - t0
+        t0 = time.time()
+        try:
+            got = sess.execute(sql).rows()
+            engine_s = time.time() - t0
+            ordered = "order by" in sql.lower() and qnum not in (2, 18, 21)
+            ok, why = rows_match(got, want, ordered=ordered)
+        except Exception as e:  # noqa: BLE001 — record, keep going
+            engine_s = time.time() - t0
+            ok, why = False, f"{type(e).__name__}: {e}"
+            got = []
+        n_ok += bool(ok)
+        results[f"q{qnum}"] = {
+            "ok": bool(ok), "rows": len(got), "oracle_rows": len(want),
+            "engine_s": round(engine_s, 3), "oracle_s": round(oracle_s, 3),
+            **({} if ok else {"why": why[:300]})}
+        print(f"Q{qnum:02d}: {'OK ' if ok else 'FAIL'} "
+              f"rows={len(got)} engine={engine_s:.2f}s "
+              f"oracle={oracle_s:.2f}s"
+              + ("" if ok else f"  [{why[:120]}]"), flush=True)
+
+    artifact = {
+        "sf": SF, "queries_ok": n_ok, "queries_total": len(QUERIES),
+        "gen_s": round(gen_s, 1), "load_engine_s": round(load_engine_s, 1),
+        "load_oracle_s": round(load_oracle_s, 1),
+        "host": {"nproc": os.cpu_count(),
+                 "platform": "cpu (no TPU this window — see TPU_PROBE log)"},
+        "results": results,
+    }
+    with open(OUT, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+    print(f"wrote {OUT}: {n_ok}/{len(QUERIES)} OK", flush=True)
+    return 0 if n_ok == len(QUERIES) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
