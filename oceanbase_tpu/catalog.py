@@ -51,6 +51,10 @@ class TableDef:
     # optimizer stats (≙ src/share/stat basic table stats)
     row_count: int = 0
     ndv: dict[str, int] = field(default_factory=dict)
+    # equi-height histograms from ANALYZE: col -> (edges ndarray in the
+    # STORAGE value domain, null_fraction) — ≙ ObOptColumnStat histogram
+    # (src/share/stat/ob_opt_column_stat.h)
+    histograms: dict = field(default_factory=dict)
     # range partitioning: (column, [upper-exclusive split points]) or None
     partition: tuple | None = None
     auto_increment_cols: list = field(default_factory=list)

@@ -655,7 +655,7 @@ def join(
     # inexact (hash-combined) semi/anti fall through: candidate counts
     # include hash collisions, so matches must be verified by expansion
 
-    keep_unmatched = how == "left"
+    keep_unmatched = how in ("left", "full")
     if keep_unmatched:
         ecounts = jnp.where(lm, jnp.maximum(counts, 1), 0)
     else:
@@ -678,13 +678,15 @@ def join(
     for name, c in left.columns.items():
         out_cols[name] = c.gather(probe_idx)
     bvalid_lane = out_live & matched
+    null_extend = how in ("left", "full")
     for name, c in right.columns.items():
         g = c.gather(build_idx)
-        v = g.valid_or_true() & bvalid_lane if how == "left" else g.valid
-        out_cols[name] = Column(g.data, v if how == "left" else g.valid,
+        v = g.valid_or_true() & bvalid_lane if null_extend else g.valid
+        out_cols[name] = Column(g.data, v if null_extend else g.valid,
                                 c.dtype, c.sdict)
 
     live = out_live & (matched | (jnp.asarray(keep_unmatched)))
+    match_lane = out_live & matched  # lanes carrying a real build pairing
     if not exact:
         # verify candidate equality on the real key columns (hash collisions)
         ok = jnp.ones(cap, dtype=jnp.bool_)
@@ -701,12 +703,13 @@ def join(
             return left.with_mask(lm & (tc > 0))
         if how == "anti":
             return left.with_mask(lm & (tc == 0))
-        if how == "left":
+        if how in ("left", "full"):
             # a lane survives as a real match, or as the single
             # NULL-extended row when its probe row has no true match
             tc_g = jnp.take(tc, probe_idx)
             null_lane = (off == 0) & (tc_g == 0)
             live = out_live & (true_lane | null_lane)
+            match_lane = true_lane
             for name in right.columns:
                 c = out_cols[name]
                 out_cols[name] = Column(c.data,
@@ -714,6 +717,34 @@ def join(
                                         c.dtype, c.sdict)
         else:
             live = live & ok
+
+    if how == "full":
+        # FULL OUTER: append one lane per build row, live when that row
+        # matched no probe lane (NULL-extended left side) — unmatched-
+        # build emission, ≙ ObHashJoinVecOp's FILL_RIGHT phase
+        # (src/sql/engine/join/hash_join/ob_hash_join_vec_op.h:342)
+        bmatch = jax.ops.segment_sum(
+            match_lane.astype(jnp.int64),
+            jnp.where(match_lane, build_idx, rn),  # rn = dropped
+            num_segments=max(rn, 1))
+        app_live = rm & (bmatch == 0)
+        zeros = jnp.zeros(rn, dtype=jnp.int64)
+        full_cols: dict[str, Column] = {}
+        for name, c in out_cols.items():
+            if name in left.columns:
+                app = left.columns[name].gather(zeros)
+                app = Column(app.data, jnp.zeros(rn, jnp.bool_),
+                             app.dtype, app.sdict)
+            else:
+                rc = right.columns[name]
+                app = Column(rc.data, rc.valid, rc.dtype, rc.sdict)
+            full_cols[name] = Column(
+                jnp.concatenate([c.data, app.data]),
+                jnp.concatenate([c.valid_or_true(),
+                                 app.valid_or_true()]),
+                c.dtype, c.sdict)
+        return Relation(columns=full_cols,
+                        mask=jnp.concatenate([live, app_live]))
 
     return Relation(columns=out_cols, mask=live)
 

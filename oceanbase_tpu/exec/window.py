@@ -99,6 +99,32 @@ def _one_window(rel: Relation, wc: ir.WindowCall) -> Column:
                 [jnp.ones(1, jnp.bool_), sv[1:] != sv[:-1]])
 
     fn = wc.fn
+    # live-row extent per partition: the lexsort puts every dead row
+    # after every live row, so a partition's live rows occupy
+    # [start_of_row, start_of_row + psize - 1] contiguously
+    psize_t = jax.ops.segment_sum(s_live.astype(jnp.int64), part_id,
+                                  num_segments=n)
+    psize = jnp.take(psize_t, part_id)
+    last_live = start_of_row + jnp.maximum(psize - 1, 0)
+
+    def _lit_int(e, default):
+        if e is None:
+            return default
+        if isinstance(e, ir.Literal) and isinstance(e.value, int):
+            return int(e.value)
+        raise NotImplementedError(
+            f"window {fn} offset must be an integer literal")
+
+    if fn == "ntile":
+        b = _lit_int((wc.extra or [None])[0], None)
+        if not b or b < 1:
+            raise NotImplementedError("ntile needs a positive bucket count")
+        q, r = psize // b, psize % b
+        j = pos_in_part
+        big = r * (q + 1)
+        res = jnp.where(j < big, j // jnp.maximum(q + 1, 1),
+                        r + (j - big) // jnp.maximum(q, 1)) + 1
+        return Column(jnp.take(res, inv), rel.mask, SqlType.int_())
     if fn == "row_number":
         res = pos_in_part + 1
         return Column(jnp.take(res, inv), rel.mask, SqlType.int_())
@@ -127,6 +153,51 @@ def _one_window(rel: Relation, wc: ir.WindowCall) -> Column:
     s_valid = jnp.take(ac.valid, order) if ac.valid is not None else None
     weight = s_live if s_valid is None else (s_live & s_valid)
 
+    # ---- navigation functions (lead/lag/first_value/last_value) --------
+    if fn in ("lead", "lag"):
+        extra = wc.extra or []
+        k = _lit_int(extra[0] if extra else None, 1)
+        shift = k if fn == "lead" else -k
+        tgt = pos + shift
+        ok = (tgt >= start_of_row) & (tgt <= last_live) & s_live
+        tgtc = jnp.clip(tgt, 0, max(n - 1, 0))
+        data = jnp.take(s_data, tgtc)
+        valid = ok if s_valid is None else (ok & jnp.take(s_valid, tgtc))
+        if len(extra) > 1 and extra[1] is not None:
+            dflt = eval_expr(extra[1], rel)
+            d_s = jnp.take(cast_column(dflt, ac.dtype).data, order)
+            data = jnp.where(ok, data, d_s)
+            dv = jnp.take(dflt.valid, order) if dflt.valid is not None \
+                else jnp.ones(n, jnp.bool_)
+            valid = jnp.where(ok, valid, dv)
+        return Column(jnp.take(data, inv), jnp.take(valid, inv) & m,
+                      ac.dtype, sdict=ac.sdict)
+    if fn in ("first_value", "last_value"):
+        fr = wc.frame
+        if fr is None:
+            # default frame: RANGE UNBOUNDED PRECEDING..CURRENT ROW —
+            # first = partition start, last = last peer of current row
+            peer_id = jnp.cumsum(new_peer.astype(jnp.int64)) - 1
+            last_pos = jax.ops.segment_max(pos, peer_id, num_segments=n)
+            tgt = start_of_row if fn == "first_value" else \
+                jnp.minimum(jnp.take(last_pos, peer_id), last_live)
+        else:
+            _unit, fs, fe = fr
+            lo = start_of_row if fs is None else \
+                jnp.maximum(pos + fs, start_of_row)
+            hi = last_live if fe is None else jnp.minimum(pos + fe,
+                                                          last_live)
+            tgt = lo if fn == "first_value" else hi
+            empty = hi < lo
+            tgt = jnp.where(empty, 0, tgt)
+        tgtc = jnp.clip(tgt, 0, max(n - 1, 0))
+        data = jnp.take(s_data, tgtc)
+        valid = s_live if s_valid is None else jnp.take(s_valid, tgtc)
+        if fr is not None:
+            valid = valid & ~empty
+        return Column(jnp.take(data, inv), jnp.take(valid, inv) & m,
+                      ac.dtype, sdict=ac.sdict)
+
     ordered = bool(wc.order_by)
     rt = SqlType.int_() if fn in ("count", "count_star") else \
         (SqlType.double() if fn == "avg" else ac.dtype)
@@ -149,7 +220,69 @@ def _one_window(rel: Relation, wc: ir.WindowCall) -> Column:
         vals, _ = jax.lax.associative_scan(seg_op, (x, flags))
         return vals
 
-    if fn in ("sum", "avg", "count", "count_star"):
+    if wc.frame is not None and fn in ("sum", "avg", "count",
+                                       "count_star", "min", "max"):
+        # explicit ROWS frame: per-row [lo, hi] ranges clamped to the
+        # partition's live extent; sums via prefix differences, min/max
+        # via a sparse table (two overlapping power-of-2 windows) —
+        # ≙ the window-function op's frame evaluation, vectorized
+        _unit, fs, fe = wc.frame
+        lo = start_of_row if fs is None else \
+            jnp.maximum(pos + fs, start_of_row)
+        hi = last_live if fe is None else jnp.minimum(pos + fe, last_live)
+        empty = (hi < lo) | ~s_live
+        lo_c = jnp.clip(lo, 0, max(n - 1, 0))
+        hi_c = jnp.clip(hi, 0, max(n - 1, 0))
+
+        def range_sum(vals):
+            cums = jnp.cumsum(vals)
+            upper = jnp.take(cums, hi_c)
+            lower = jnp.where(lo_c > 0,
+                              jnp.take(cums, jnp.maximum(lo_c - 1, 0)), 0)
+            return jnp.where(empty, 0, upper - lower)
+
+        cnt = range_sum(weight.astype(jnp.int64))
+        if fn in ("min", "max"):
+            from oceanbase_tpu.exec.ops import _agg_identity
+
+            ident = _agg_identity(fn, s_data.dtype)
+            opf = jnp.minimum if fn == "min" else jnp.maximum
+            x = jnp.where(weight, s_data, ident)
+            # sparse table: sp[j][i] = op over [i, i + 2^j - 1].  Levels
+            # cap at log2(max frame length) when both bounds are finite —
+            # a 3-row sliding frame must not materialize log2(n) copies
+            if fs is not None and fe is not None:
+                max_len = max(fe - fs + 1, 1)
+            else:
+                max_len = max(n, 2)
+            levels = max(int(np.ceil(np.log2(max(max_len, 2)))) + 1, 1)
+            sp = [x]
+            for j in range(1, levels):
+                half = 1 << (j - 1)
+                shifted = jnp.concatenate(
+                    [sp[-1][half:], jnp.full(min(half, n), ident,
+                                             dtype=x.dtype)])[:n]
+                sp.append(opf(sp[-1], shifted))
+            table = jnp.stack(sp)  # (levels, n)
+            ln = hi_c - lo_c + 1
+            k = jnp.clip(
+                jnp.floor(jnp.log2(jnp.maximum(ln, 1).astype(
+                    jnp.float64))).astype(jnp.int64), 0, levels - 1)
+            flat = table.reshape(-1)
+            a = jnp.take(flat, k * n + lo_c)
+            b = jnp.take(flat, k * n + jnp.maximum(
+                hi_c - (1 << k) + 1, 0))
+            run = jnp.where(empty, ident, opf(a, b))
+        else:
+            xs = jnp.where(weight,
+                           s_data if fn in ("sum", "avg")
+                           else jnp.ones(n, dtype=jnp.int64),
+                           jnp.zeros((), s_data.dtype
+                                     if fn in ("sum", "avg")
+                                     else jnp.int64))
+            run = range_sum(xs)
+        ordered = False  # frame computed exactly; no peer smearing
+    elif fn in ("sum", "avg", "count", "count_star"):
         x = jnp.where(weight, s_data if fn in ("sum", "avg")
                       else jnp.ones(n, dtype=jnp.int64),
                       jnp.zeros((), s_data.dtype if fn in ("sum", "avg")

@@ -217,22 +217,31 @@ class FuncCall(Expr):
 
 @dataclass(eq=False)
 class WindowCall(Expr):
-    """fn() OVER (PARTITION BY ... ORDER BY ...).
+    """fn() OVER (PARTITION BY ... ORDER BY ... [frame]).
 
     Evaluated by the Window operator (≙ src/sql/engine/window_function).
-    Supported fns: row_number, rank, dense_rank, sum, count, avg, min, max
-    (ordered window aggregates use the MySQL default frame: RANGE
-    UNBOUNDED PRECEDING .. CURRENT ROW, i.e. peers share values)."""
+    Supported fns: row_number, rank, dense_rank, ntile, lead, lag,
+    first_value, last_value, sum, count, avg, min, max.  Without an
+    explicit frame, ordered window aggregates use the MySQL default:
+    RANGE UNBOUNDED PRECEDING .. CURRENT ROW (peers share values).
+
+    ``frame``: ("rows", start, end) — offsets relative to the current
+    row (negative = PRECEDING, None = UNBOUNDED on that side).
+    ``extra``: positional extras — lead/lag (offset, default expr),
+    ntile (bucket count)."""
 
     fn: str
     arg: "Expr | None" = None
     partition_by: list = None
     order_by: list = None       # list[(Expr, ascending)]
+    frame: tuple | None = None  # ("rows", start|None, end|None)
+    extra: list = None
 
     def children(self):
         cs = [self.arg] if self.arg is not None else []
         cs += list(self.partition_by or [])
         cs += [e for e, _ in (self.order_by or [])]
+        cs += [e for e in (self.extra or []) if isinstance(e, Expr)]
         return tuple(cs)
 
 

@@ -1,0 +1,366 @@
+"""NodeServer: one observer process of a multi-node cluster.
+
+Reference analog: ObServer (src/observer/ob_server.cpp:228) hosting the
+rpc frame, log service, storage, and SQL for one server — reduced to the
+sys tenant.  The replication plane is a networked PALF group
+(palf/netcluster.py, ≙ palf_handle_impl receive_log RPCs); DDL and DML
+redo both ride it, so every node converges to the same engine state.
+Writes execute on the PALF leader (statement routing on OB_NOT_MASTER,
+≙ DML retry via the location cache); strong reads from a follower route
+to the leader; weak reads (`consistency='weak'`) run on the local
+replica (≙ weak-consistency replica reads).  ``das.scan`` serves
+chunk-streamed snapshot column fetches for remote-relation access
+(≙ ObDataAccessService, src/sql/das/ob_data_access_service.h:21).
+
+CLI:  python -m oceanbase_tpu.net.node --node-id 1 --port 7001 \
+          --peers 2=127.0.0.1:7002,3=127.0.0.1:7003 --root /tmp/n1 \
+          [--bootstrap]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+import numpy as np
+
+from oceanbase_tpu.net.rpc import RpcClient, RpcError, RpcServer
+from oceanbase_tpu.palf.cluster import NoQuorum, NotLeader
+from oceanbase_tpu.palf.netcluster import NetPalf
+from oceanbase_tpu.share.location import LocationCache
+
+_DDL_KINDS = {"create_table", "drop_table", "truncate", "alter_add",
+              "alter_drop", "create_index", "drop_index"}
+_WRITE_PREFIXES = ("insert", "update", "delete", "replace", "create",
+                   "drop", "alter", "truncate", "load", "begin",
+                   "commit", "rollback")
+SCAN_CHUNK_ROWS = 65536
+
+
+class NodeDatabase:
+    """Database facade for one node process: the attribute surface
+    sessions touch (config, tx/engine routing, observability), bound to
+    the node's sys tenant over the networked WAL."""
+
+    def __init__(self, node, root):
+        import itertools
+
+        from oceanbase_tpu.server.monitor import PlanMonitor, SqlAudit
+
+        self._node = node
+        self.root = root
+        self.config = node.config
+        self.tenants = {"sys": node.tenant}
+        self.workarea_history: list = []
+        self.plan_monitor = PlanMonitor()
+        self.audit = SqlAudit(int(self.config["sql_audit_queue_size"]))
+        self.ash = None
+        self._session_ids = itertools.count(1)
+
+    @property
+    def tx(self):
+        return self._node.tx
+
+    @property
+    def engine(self):
+        return self._node.engine
+
+    @property
+    def catalog(self):
+        return self._node.catalog
+
+    def create_tenant(self, *a, **kw):
+        raise NotImplementedError(
+            "tenant DDL is a rootservice operation; run it on the "
+            "cluster bootstrap node")
+
+    drop_tenant = create_tenant
+
+
+class NodeServer:
+    def __init__(self, node_id: int, host: str, port: int,
+                 peers: dict[int, tuple[str, int]],
+                 root: str | None = None, bootstrap: bool = False,
+                 lease_ms: int = 2000):
+        import os
+
+        from oceanbase_tpu.server.config import Config
+        from oceanbase_tpu.server.tenant import Tenant
+
+        self.node_id = node_id
+        self.peers = {pid: RpcClient(h, p)
+                      for pid, (h, p) in peers.items()}
+        self._apply_lock = threading.RLock()
+        self._replay_pending: dict = {}
+
+        wal_dir = os.path.join(root, "wal") if root else None
+        self.palf = NetPalf(node_id, self.peers, log_dir=wal_dir,
+                            apply_cb=self._apply_entry,
+                            lease_ms=lease_ms)
+        self.config = Config(persist_path=(
+            os.path.join(root, "config.json") if root else None))
+        self.tenant = Tenant("sys", root, self.config, wal=self.palf)
+        self.engine = self.tenant.engine
+        self.tx = self.tenant.tx
+        self.catalog = self.tenant.catalog
+        # replicate logical DDL through the log stream (followers apply
+        # in _apply_entry; physical segment ops stay node-local)
+        self.engine.ddl_wal_cb = self._on_local_ddl
+        self.db = NodeDatabase(self, root)
+        self.location = LocationCache(node_id, self.peers,
+                                      self.palf._on_state)
+
+        handlers = {
+            "ping": lambda: "pong",
+            "das.scan": self._h_scan,
+            "sql.execute": self._h_execute,
+            "node.state": self._h_state,
+            **self.palf.handlers(),
+        }
+        self.server = RpcServer(host, port, handlers)
+        self._sessions: dict = {}
+        self._stop = threading.Event()
+        self._hb: threading.Thread | None = None
+        self._bootstrap = bootstrap
+
+    # ------------------------------------------------------------------
+    # WAL apply (follower replay; ≙ replayservice)
+    # ------------------------------------------------------------------
+    def _apply_entry(self, entry):
+        with self._apply_lock:
+            if entry.lsn in self.palf.local_lsns:
+                # leader-originated: the write path already applied it
+                self.palf.local_lsns.discard(entry.lsn)
+                return
+            try:
+                rec = json.loads(entry.payload.decode())
+            except Exception:
+                return
+            from oceanbase_tpu.tx.service import TransService
+
+            max_ts = TransService.replay([entry], self.engine,
+                                         pending=self._replay_pending)
+            if rec.get("op") == "ddl":
+                self.catalog.schema_version += 1
+            if max_ts:
+                self.tx.gts.advance_to(max_ts)
+
+    def _on_local_ddl(self, op: dict):
+        """Engine slog hook: replicate logical DDL when leading (a
+        follower reaching here is applying REMOTE ddl — don't re-ship)."""
+        if op.get("op") not in _DDL_KINDS:
+            return
+        if not self.palf.is_leader:
+            return
+        self.palf.append([json.dumps({"op": "ddl", "slog": op}).encode()])
+
+    # ------------------------------------------------------------------
+    # RPC handlers
+    # ------------------------------------------------------------------
+    def _h_state(self):
+        return {"node_id": self.node_id,
+                "tables": sorted(t for t in self.engine.tables
+                                 if not t.startswith("__idx__")),
+                "gts": self.tx.gts.current(),
+                **self.palf._on_state()}
+
+    def _h_scan(self, table: str, snapshot: int | None = None,
+                offset: int = 0, limit: int = SCAN_CHUNK_ROWS):
+        """One chunk of a snapshot scan; the caller pages via
+        offset/limit (streamed batches, ≙ the DAS scan iterator)."""
+        ts = self.engine.tables.get(table)
+        if ts is None:
+            raise KeyError(f"table {table} not on node {self.node_id}")
+        snap = int(snapshot) if snapshot else self.tx.gts.current()
+        arrays, valids = ts.tablet.snapshot_arrays(snap)
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        s, e = min(offset, n), min(offset + limit, n)
+        return {
+            "snapshot": snap, "total": n,
+            "arrays": {k: np.asarray(v)[s:e]
+                       for k, v in arrays.items()},
+            "valids": {k: np.asarray(v)[s:e]
+                       for k, v in valids.items() if v is not None},
+            "types": {c.name: [c.dtype.kind.value, c.dtype.precision or 0,
+                               c.dtype.scale or 0]
+                      for c in ts.tdef.columns},
+        }
+
+    def _h_execute(self, sql: str, consistency: str = "strong",
+                   session_id: int = 0, forwarded: bool = False):
+        return self.execute(sql, consistency=consistency,
+                            session_id=session_id, _forwarded=forwarded)
+
+    # ------------------------------------------------------------------
+    # SQL surface
+    # ------------------------------------------------------------------
+    def _session(self, session_id: int = 0):
+        from oceanbase_tpu.sql.session import Session
+
+        s = self._sessions.get(session_id)
+        if s is None:
+            s = Session(self.catalog, tenant=self.tenant, db=self.db)
+            self._sessions[session_id] = s
+        return s
+
+    @staticmethod
+    def _is_write(sql: str) -> bool:
+        return sql.lstrip().lower().startswith(_WRITE_PREFIXES)
+
+    def execute(self, sql: str, consistency: str = "strong",
+                session_id: int = 0, _forwarded: bool = False) -> dict:
+        """-> {names, arrays, valids, rowcount, types, node}."""
+        if self.palf.is_leader:
+            return self._run_local(sql, session_id)
+        if not self._is_write(sql) and consistency != "strong":
+            return self._run_local(sql, session_id)  # weak local read
+        if _forwarded:
+            # a peer believed we lead but we don't — make it retry
+            raise NotLeader(f"node {self.node_id} is not the leader")
+        return self._forward(sql, consistency, session_id)
+
+    def _run_local(self, sql: str, session_id: int) -> dict:
+        s = self._session(session_id)
+        res = s.execute(sql)
+        arrays, valids = {}, {}
+        for name in res.names:
+            arrays[name] = np.asarray(res.arrays[name])
+            v = res.valids.get(name)
+            if v is not None:
+                valids[name] = np.asarray(v)
+        return {"names": list(res.names), "arrays": arrays,
+                "valids": valids, "rowcount": int(res.rowcount),
+                "types": {n: [t.kind.value, t.precision or 0,
+                              t.scale or 0]
+                          for n, t in res.dtypes.items()
+                          if t is not None},
+                "node": self.node_id}
+
+    def _forward(self, sql: str, consistency: str, session_id: int):
+        """Route to the leader; campaign ourselves when none is
+        reachable (≙ OB_NOT_MASTER retry + failover)."""
+        last_err: Exception | None = None
+        for _attempt in range(4):
+            target = self.location.leader()
+            if target is None or target == self.node_id:
+                try:
+                    self.palf.elect()
+                except NoQuorum as e:
+                    last_err = e
+                    time.sleep(0.25)
+                    continue
+                return self._run_local(sql, session_id)
+            try:
+                return self.peers[target].call(
+                    "sql.execute", sql=sql, consistency=consistency,
+                    session_id=(self.node_id << 32) | session_id,
+                    forwarded=True)
+            except (OSError, RpcError) as e:
+                if isinstance(e, RpcError) and e.kind not in (
+                        "NotLeader", "NoQuorum"):
+                    raise
+                last_err = e
+                self.location.invalidate()
+                time.sleep(0.25)
+        raise NotLeader(f"no reachable leader: {last_err}")
+
+    # ------------------------------------------------------------------
+    # remote-relation fetch (DAS client side)
+    # ------------------------------------------------------------------
+    def fetch_remote_table(self, table: str, node_id: int | None = None,
+                           snapshot: int | None = None):
+        """Stream a table's snapshot from its home node in chunks
+        -> (arrays, valids, types, snapshot)."""
+        if node_id is None:
+            node_id = self.location.home_of(table)
+        cli = self.peers[node_id]
+        chunks = []
+        snap, off = snapshot, 0
+        while True:
+            r = cli.call("das.scan", table=table, snapshot=snap,
+                         offset=off, limit=SCAN_CHUNK_ROWS)
+            snap = r["snapshot"]
+            chunks.append(r)
+            off += SCAN_CHUNK_ROWS
+            if off >= r["total"]:
+                break
+        arrays, valids = {}, {}
+        for k in chunks[0]["arrays"]:
+            arrays[k] = np.concatenate([c["arrays"][k] for c in chunks])
+        for k in chunks[0].get("valids", {}):
+            valids[k] = np.concatenate([c["valids"][k] for c in chunks])
+        return arrays, valids, chunks[0]["types"], snap
+
+    # ------------------------------------------------------------------
+    def start(self):
+        self.server.start()
+        self._hb = threading.Thread(target=self._heartbeat, daemon=True)
+        self._hb.start()
+        if self._bootstrap:
+            threading.Thread(target=self._bootstrap_elect,
+                             daemon=True).start()
+
+    def _bootstrap_elect(self):
+        """Campaign until a majority of peers is reachable (cluster
+        bootstrap, ≙ rootservice bootstrap electing the first leader)."""
+        while not self._stop.is_set():
+            try:
+                if self.location.leader() is not None:
+                    return
+                self.palf.elect()
+                return
+            except NoQuorum:
+                time.sleep(0.3)
+
+    def _heartbeat(self):
+        period = self.palf.proposer.lease_ms / 4000.0
+        while not self._stop.wait(period):
+            try:
+                if self.palf.replica.role == "leader":
+                    self.palf.tick()
+            except Exception:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        self.server.stop()
+        self.palf.close()
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--node-id", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--peers", default="",
+                    help="id=host:port,id=host:port")
+    ap.add_argument("--root", default=None)
+    ap.add_argument("--bootstrap", action="store_true")
+    ap.add_argument("--lease-ms", type=int, default=2000)
+    args = ap.parse_args(argv)
+    peers = {}
+    for part in filter(None, args.peers.split(",")):
+        pid, addr = part.split("=")
+        h, p = addr.rsplit(":", 1)
+        peers[int(pid)] = (h, int(p))
+    node = NodeServer(args.node_id, args.host, args.port, peers,
+                      root=args.root, bootstrap=args.bootstrap,
+                      lease_ms=args.lease_ms)
+    node.start()
+    print(f"node {args.node_id} listening on {args.host}:{node.port}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        node.stop()
+
+
+if __name__ == "__main__":
+    main()

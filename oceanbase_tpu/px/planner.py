@@ -75,11 +75,39 @@ def _row_bytes(rel) -> int:
     return max(b, 1)
 
 _DIST_OK = (pp.TableScan, pp.Filter, pp.Project, pp.GroupBy,
-            pp.HashJoin, pp.SemiJoinResidual, pp.Union, pp.Compact)
+            pp.HashJoin, pp.SemiJoinResidual, pp.Union, pp.Compact,
+            pp.Window)
 
 
 class NotDistributable(Exception):
     pass
+
+
+def _elide_inner_sorts(node: pp.PlanNode, under_limit: bool = False):
+    """Drop Sort nodes that are neither at the root nor directly under a
+    Limit: SQL gives no ordering guarantee for subquery/derived-table
+    intermediates, so the sort is dead work — and eliding it lets the
+    rest of the plan distribute (a mid-plan Sort would otherwise force
+    serial execution).  Sort+Limit (top-k) keeps its Sort."""
+    import dataclasses
+
+    if isinstance(node, pp.Sort) and not under_limit:
+        return _elide_inner_sorts(node.child, False)
+    fields = {}
+    changed = False
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, pp.PlanNode):
+            nv = _elide_inner_sorts(v, isinstance(node, pp.Limit))
+            fields[f.name] = nv
+            changed = changed or nv is not v
+        elif f.name == "inputs" and isinstance(v, list):
+            nv = [_elide_inner_sorts(c, False) for c in v]
+            fields[f.name] = nv
+            changed = changed or any(a is not b for a, b in zip(nv, v))
+    if not changed:
+        return node
+    return dataclasses.replace(node, **fields)
 
 
 def split_top(plan: pp.PlanNode):
@@ -107,6 +135,7 @@ def split_top(plan: pp.PlanNode):
             node = node.child
             continue
         break
+    node = _elide_inner_sorts(node)
     _check_distributable(node)
     return top, scalar_agg, node
 
@@ -275,6 +304,31 @@ def _dlower(node: pp.PlanNode, tables: dict, ndev: int, axis: str,
                             how=node.how, out_capacity=local_cap)
         return _djoin(left, right, node.left_keys, node.right_keys,
                       node.how, node.out_capacity, ndev, axis, factor)
+    if isinstance(node, pp.Window):
+        child = _dlower(node.child, tables, ndev, axis, factor, elide)
+        # distributed window: hash-repartition on the PARTITION BY keys
+        # so each partition lands whole on one shard, then the local
+        # window operator runs unchanged (≙ PKEY repartition feeding
+        # ObWindowFunctionVecOp; single-partition windows can't split)
+        from oceanbase_tpu.exec.window import window as exec_window
+        from oceanbase_tpu.px.exchange import all_to_all_repartition
+
+        pkeys = None
+        for _out, wc in node.specs:
+            pk = tuple(map(repr, wc.partition_by or []))
+            if not pk or (pkeys is not None and pk != pkeys[0]):
+                raise NotDistributable(
+                    "window without common PARTITION BY")
+            pkeys = (pk, wc.partition_by)
+        keys = pkeys[1]
+        if not _keys_hash_partitionable(child, child, keys, keys):
+            raise NotDistributable("window partition keys not hashable")
+        per_dest = max((child.capacity + ndev - 1) // ndev * 2,
+                       1024) * factor
+        recv, ovf = all_to_all_repartition(child, keys, ndev, per_dest,
+                                           axis)
+        diag.push("px_exchange_overflow", ovf)
+        return exec_window(recv, node.specs)
     if isinstance(node, pp.SemiJoinResidual):
         left = _dlower(node.left, tables, ndev, axis, factor, elide)
         right = _dlower(node.right, tables, ndev, axis, factor, elide)
@@ -330,6 +384,23 @@ def _keys_hash_partitionable(left, right, lkeys, rkeys) -> bool:
 
 
 def _djoin(left, right, lkeys, rkeys, how, cap, ndev, axis, factor=1):
+    if how == "full":
+        # broadcast would emit each unmatched build row once PER SHARD;
+        # only hash-hash co-location keeps unmatched-build emission
+        # single (≙ the reference forcing HASH dist for full outer)
+        if not lkeys or not _keys_hash_partitionable(left, right,
+                                                     lkeys, rkeys):
+            raise NotDistributable("full outer join needs "
+                                   "hash-partitionable keys")
+        per_dest = max((max(left.capacity, right.capacity) + ndev - 1)
+                       // ndev * 2, 1024) * factor
+        local_cap = cap if cap is None else max(cap // ndev * 2, 1024)
+        out, ovf = dist_join_shard(
+            left, right, lkeys, rkeys, ndev=ndev, cap_per_dest=per_dest,
+            probe_cap_per_dest=per_dest, out_capacity=local_cap,
+            how=how, axis_name=axis)
+        diag.push("px_exchange_overflow", ovf)
+        return out
     if right.capacity * _row_bytes(right) <= BROADCAST_THRESHOLD_BYTES \
             or not lkeys \
             or not _keys_hash_partitionable(left, right, lkeys, rkeys):

@@ -85,6 +85,11 @@ DEF("px_exchange_capacity_per_dest", 1 << 20, "int",
     "all_to_all per-destination row budget", _pos)
 DEF("px_workers_per_tenant", 64, "int",
     "PX admission quota (≙ px_workers_per_cpu_quota)", _pos)
+DEF("pdml_min_rows", 8192, "int",
+    "parallel-DML threshold: statements writing at least this many rows "
+    "fan the write phase out over tenant workers (≙ enable_parallel_dml "
+    "+ the PDML DFO split, src/sql/engine/pdml)", _pos)
+DEF("pdml_dop", 4, "int", "parallel-DML worker count", _pos)
 
 # storage
 DEF("memstore_limit_rows", 1_000_000, "int",

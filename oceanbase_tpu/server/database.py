@@ -55,6 +55,20 @@ class Database:
         if start_ash and self.config["enable_ash"]:
             self.ash.start()
 
+        # user store: mysql_native_password hashes (≙ __all_user);
+        # root starts passwordless like a fresh deployment
+        from oceanbase_tpu.server.mysql_protocol import mysql_native_hash
+
+        self.users: dict[str, bytes] = {"root": mysql_native_hash("")}
+        self._users_path = (os.path.join(root, "users.json")
+                            if root else None)
+        if self._users_path and os.path.exists(self._users_path):
+            import json as _json
+
+            with open(self._users_path) as fh:
+                self.users = {u: bytes.fromhex(h)
+                              for u, h in _json.load(fh).items()}
+
         # boot tenants: 'sys' plus any persisted tenant directories
         self.create_tenant("sys", wal_replicas=wal_replicas, _boot=True)
         if root:
@@ -96,6 +110,34 @@ class Database:
 
     def tenant(self, name: str = "sys") -> Tenant:
         return self.tenants[name]
+
+    # -- users (mysql_native_password credentials) -----------------------
+    def create_user(self, name: str, password: str):
+        from oceanbase_tpu.server.mysql_protocol import mysql_native_hash
+
+        self.users[name] = mysql_native_hash(password)
+        self._persist_users()
+
+    def drop_user(self, name: str):
+        if name == "root":
+            raise ValueError("cannot drop root")
+        self.users.pop(name, None)
+        self._persist_users()
+
+    def set_password(self, name: str, password: str):
+        if name not in self.users:
+            raise KeyError(f"unknown user {name}")
+        self.create_user(name, password)
+
+    def _persist_users(self):
+        if not self._users_path:
+            return
+        import json as _json
+
+        tmp = self._users_path + ".tmp"
+        with open(tmp, "w") as fh:
+            _json.dump({u: h.hex() for u, h in self.users.items()}, fh)
+        os.replace(tmp, self._users_path)
 
     # -- sys-tenant convenience (single-tenant callers) ------------------
     @property

@@ -4,15 +4,18 @@ Reference analog: the obmysql protocol stack + command processors
 (deps/oblib/src/rpc/obmysql, src/observer/mysql — obmp_query, result
 drivers serializing rows to MySQL packets, ob_sync_plan_driver.cpp).
 
-Implements protocol 4.1 (text protocol): handshake v10, COM_QUERY /
+Implements protocol 4.1 (text protocol): handshake v10 with real
+mysql_native_password verification against the database's user store
+(≙ obsm_handler auth; src/observer/mysql/obsm_handler.cpp), COM_QUERY /
 COM_PING / COM_INIT_DB / COM_QUIT, OK/ERR/EOF packets, column
-definitions and text resultset rows.  Any username/password is accepted
-(authentication plugs in later); one engine Session per connection.
-A thread per connection (≙ one ObThWorker serving the session).
+definitions and text resultset rows.  One engine Session per connection;
+a thread per connection (≙ one ObThWorker serving the session).
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
 import socket
 import socketserver
 import struct
@@ -119,7 +122,9 @@ class _Conn:
 
     # ---- handshake ------------------------------------------------------
     def handshake(self) -> bool:
-        salt = b"0123456789abcdefghij"
+        # random 20-byte salt, ascii-safe (no NULs — the greeting is
+        # NUL-delimited)
+        salt = bytes(0x21 + (b % 0x5d) for b in os.urandom(20))
         greeting = (
             b"\x0a" + b"5.7.0-oceanbase-tpu\x00" +
             struct.pack("<I", threading.get_ident() & 0xFFFFFFFF) +
@@ -136,9 +141,34 @@ class _Conn:
         resp = self.recv()
         if resp is None:
             return False
-        # accept any credentials (auth service plugs in later)
+        user, token = self._parse_handshake_response(resp)
+        users = getattr(self.session.db, "users", None) \
+            if self.session.db is not None else None
+        if not _verify_native_password(users, user, token, salt):
+            self.send_err(1045, f"Access denied for user '{user}'",
+                          state=b"28000")
+            return False
         self.send_ok()
         return True
+
+    @staticmethod
+    def _parse_handshake_response(resp: bytes):
+        """-> (username, auth_token) from a protocol-4.1 login packet."""
+        try:
+            caps = struct.unpack_from("<I", resp, 0)[0]
+            off = 4 + 4 + 1 + 23  # caps, max packet, charset, reserved
+            end = resp.index(b"\x00", off)
+            user = resp[off:end].decode("utf-8", "replace")
+            off = end + 1
+            if caps & CLIENT_SECURE_CONNECTION:
+                n = resp[off]
+                token = resp[off + 1:off + 1 + n]
+            else:
+                end = resp.find(b"\x00", off)
+                token = resp[off:end if end >= 0 else len(resp)]
+            return user, token
+        except (IndexError, ValueError, struct.error):
+            return "", b""
 
     # ---- result sets ----------------------------------------------------
     def send_resultset(self, result):
@@ -380,6 +410,32 @@ class _Conn:
             self.send_resultset(result)
         else:
             self.send_ok(affected=result.rowcount)
+
+
+def mysql_native_hash(password: str) -> bytes:
+    """Stored credential: SHA1(SHA1(password)) — mysql_native_password."""
+    return hashlib.sha1(
+        hashlib.sha1(password.encode()).digest()).digest()
+
+
+def _verify_native_password(users, user: str, token: bytes,
+                            salt: bytes) -> bool:
+    """Challenge verification: client sends
+    SHA1(pw) XOR SHA1(salt + SHA1(SHA1(pw))); recover SHA1(pw) and check
+    SHA1(SHA1(pw)) against the stored hash."""
+    if users is None:
+        # no user store wired (bare Session tests): root/empty only
+        users = {"root": mysql_native_hash("")}
+    stored = users.get(user)
+    if stored is None:
+        return False
+    if stored == mysql_native_hash(""):
+        return token == b""  # empty password: client sends no token
+    if len(token) != 20:
+        return False
+    mask = hashlib.sha1(salt + stored).digest()
+    sha_pw = bytes(a ^ b for a, b in zip(token, mask))
+    return hashlib.sha1(sha_pw).digest() == stored
 
 
 class MySQLServer:

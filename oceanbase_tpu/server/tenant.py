@@ -26,7 +26,10 @@ from oceanbase_tpu.tx.service import TransService
 
 class Tenant:
     def __init__(self, name: str, root: str | None, cluster_config: Config,
-                 wal_replicas: int = 3):
+                 wal_replicas: int = 3, wal=None):
+        """``wal``: inject an external log handle (a NetPalf group whose
+        replicas live in other OS processes, palf/netcluster.py) instead
+        of the in-process PalfCluster — the multi-node path."""
         self.name = name
         self.config = Config(parent=cluster_config)
         data_dir = os.path.join(root, "data") if root else None
@@ -34,17 +37,26 @@ class Tenant:
         if wal_dir:
             os.makedirs(wal_dir, exist_ok=True)
         self.engine = StorageEngine(data_dir)
-        self.wal = PalfCluster(wal_replicas, log_root=wal_dir)
-        self.wal.elect()
+        if wal is not None:
+            self.wal = wal
+            local = wal.replica  # NetPalf: this process's replica
+        else:
+            self.wal = PalfCluster(wal_replicas, log_root=wal_dir)
+            self.wal.elect()
+            local = self.wal.replicas[self.wal.leader_id]
         self.tx = TransService(wal=self.wal)
         self.tx.engine = self.engine  # secondary-index maintenance
 
-        ldr = self.wal.replicas[self.wal.leader_id]
         start = self.engine.meta.get("wal_lsn", 0)
-        if ldr.committed_lsn > start:
+        if local.committed_lsn > start:
             max_ts = TransService.replay(
-                ldr.entries[start:ldr.committed_lsn], self.engine)
+                local.entries[start:local.committed_lsn], self.engine)
             self.tx.gts.advance_to(max_ts)
+        # incremental apply (multi-node) resumes where boot replay ended:
+        # entries at/below the checkpoint replay-point are already in the
+        # engine (segments/slog), later committed ones were just replayed
+        local.applied_lsn = max(local.applied_lsn, start,
+                                local.committed_lsn)
         self.tx.gts.advance_to(self.engine.meta.get("gts", 0))
         # bulk_load (CTAS / LOAD DATA / direct load) stamps segments with
         # GTS values that reach neither the WAL nor (pre-checkpoint) the

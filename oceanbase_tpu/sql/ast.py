@@ -239,6 +239,15 @@ class TenantStmt:
 
 
 @dataclass
+class UserStmt:
+    """CREATE USER / DROP USER / SET PASSWORD (≙ DCL over __all_user)."""
+
+    op: str      # create | drop | set_password
+    name: str = ""
+    password: str = ""
+
+
+@dataclass
 class ShowStmt:
     what: str    # variables | parameters
 

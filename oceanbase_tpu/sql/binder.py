@@ -108,6 +108,8 @@ class Fragment:
     unique_cols: frozenset = frozenset()  # colids known unique (PK)
     colids: frozenset = frozenset()       # every colid this subtree produces
     ndv: dict = field(default_factory=dict)  # colid -> distinct-value est
+    # colid -> (equi-height edges, null_frac, SqlType) from ANALYZE
+    hist: dict = field(default_factory=dict)
 
     def __post_init__(self):
         if not self.colids:
@@ -401,6 +403,7 @@ class Binder:
         cols = {}
         unique = []
         ndv = {}
+        hist = {}
         for c in tdef.columns:
             cid = fresh(f"{alias}_{c.name}")
             rename[c.name] = cid
@@ -408,12 +411,16 @@ class Binder:
             cols[c.name] = cid
             if c.name in tdef.ndv:
                 ndv[cid] = tdef.ndv[c.name]
+            if c.name in getattr(tdef, "histograms", {}):
+                edges, nf = tdef.histograms[c.name]
+                hist[cid] = (edges, nf, c.dtype)
         if len(tdef.primary_key) == 1:
             unique.append(rename[tdef.primary_key[0]])
             ndv[rename[tdef.primary_key[0]]] = max(tdef.row_count, 1)
         qb.fragments.append(Fragment(
             pp.TableScan(name, rename=rename),
             cols, max(tdef.row_count, 1), frozenset(unique), ndv=ndv,
+            hist=hist,
         ))
 
     def _bind_join(self, j: ast.JoinRef, qb: QueryBlock, scope: Scope):
@@ -430,9 +437,11 @@ class Binder:
             return
         if j.kind == "right":
             j = ast.JoinRef(j.right, j.left, "left", j.on)
-        # LEFT join binds eagerly.  Each side binds into its OWN QueryBlock
-        # so inner-join edges inside a side stay locally indexed, then the
-        # side collapses to one fragment via the join-tree builder.
+        # LEFT/FULL join binds eagerly.  Each side binds into its OWN
+        # QueryBlock so inner-join edges inside a side stay locally
+        # indexed, then the side collapses to one fragment via the
+        # join-tree builder.
+        how = "full" if j.kind == "full" else "left"
         lf = self._bind_side(j.left, scope)
         rf = self._bind_side(j.right, scope)
         on = j.on
@@ -442,14 +451,22 @@ class Binder:
             lpreds = rpreds = residual = []
         else:
             eqs, lpreds, rpreds, residual = self._split_on(on, lf, rf, scope)
+        if how == "full" and (lpreds or rpreds or residual):
+            # a one-sided/residual ON pred of a FULL join only nullifies
+            # matches — it cannot filter either side; no sound lowering
+            # exists in this plan shape yet (≙ non-equi full outer)
+            raise BindError(
+                "FULL OUTER JOIN supports equi-join ON conditions only")
         for p in rpreds:
             rf = Fragment(pp.Filter(rf.plan, p), rf.cols,
                           max(1, rf.est_rows // 3), rf.unique_cols,
                           colids=rf.colids, ndv=rf.ndv)
         lkeys = [e[0] for e in eqs]
         rkeys = [e[1] for e in eqs]
-        cap = _pow2(int(lf.est_rows * 1.5) + 16)
-        plan = pp.HashJoin(lf.plan, rf.plan, lkeys, rkeys, how="left",
+        cap = _pow2(int((lf.est_rows + (rf.est_rows
+                                        if how == "full" else 0))
+                        * 1.5) + 16)
+        plan = pp.HashJoin(lf.plan, rf.plan, lkeys, rkeys, how=how,
                            out_capacity=cap)
         for p in lpreds + residual:
             # ON predicates on the left side of a LEFT JOIN semantically
@@ -458,10 +475,15 @@ class Binder:
             # matched rows only — round-1: treat as join residual filter
             plan = pp.Filter(plan, p)
         merged_cols = {**lf.cols, **rf.cols}
-        qb.fragments.append(Fragment(plan, merged_cols, lf.est_rows,
-                                     lf.unique_cols,
-                                     colids=lf.colids | rf.colids,
-                                     ndv={**lf.ndv, **rf.ndv}))
+        # FULL emits unmatched build rows too, and NULL-extends the left
+        # PKs on them (no longer unique downstream)
+        out_est = lf.est_rows + (rf.est_rows if how == "full" else 0)
+        qb.fragments.append(Fragment(
+            plan, merged_cols, out_est,
+            frozenset() if how == "full" else lf.unique_cols,
+            colids=lf.colids | rf.colids,
+            ndv={**lf.ndv, **rf.ndv},
+            hist={**lf.hist, **rf.hist}))
 
     def _bind_side(self, tref, scope: Scope) -> Fragment:
         """Bind one side of an eager (outer) join into a single fragment."""
@@ -479,12 +501,15 @@ class Binder:
         colids = frozenset()
         unique = frozenset()
         ndv = {}
+        hist = {}
         for f in sub_qb.fragments:
             cols.update(f.cols)
             colids |= f.colids
             unique |= f.unique_cols
             ndv.update(f.ndv)
-        return Fragment(plan, cols, est, unique, colids=colids, ndv=ndv)
+            hist.update(f.hist)
+        return Fragment(plan, cols, est, unique, colids=colids, ndv=ndv,
+                        hist=hist)
 
     @staticmethod
     def _col_in(frag: Fragment, name: str) -> str:
@@ -575,8 +600,9 @@ class Binder:
                 f = qb.fragments[i]
                 qb.fragments[i] = Fragment(
                     pp.Filter(f.plan, bound), f.cols,
-                    max(1, int(f.est_rows * _selectivity(bound))),
+                    max(1, int(f.est_rows * _selectivity(bound, f.hist))),
                     f.unique_cols, colids=f.colids, ndv=f.ndv,
+                    hist=f.hist,
                 )
             else:
                 qb.post_preds.append(bound)  # constant predicate
@@ -805,7 +831,10 @@ class Binder:
                 [self.bind_expr(p, scope, allow_agg)
                  for p in (e.partition_by or [])],
                 [(self.bind_expr(o, scope, allow_agg), asc)
-                 for o, asc in (e.order_by or [])])
+                 for o, asc in (e.order_by or [])],
+                frame=e.frame,
+                extra=[self.bind_expr(x, scope, allow_agg)
+                       for x in (e.extra or [])] or None)
         return _map_children(
             e, lambda c: self.bind_expr(c, scope, allow_agg, qb_plan)
         )
@@ -1105,7 +1134,9 @@ def _map_children(e: ir.Expr, fn):
         return ir.WindowCall(
             e.fn, fn(e.arg) if e.arg is not None else None,
             [fn(p) for p in (e.partition_by or [])],
-            [(fn(o), asc) for o, asc in (e.order_by or [])])
+            [(fn(o), asc) for o, asc in (e.order_by or [])],
+            frame=e.frame,
+            extra=[fn(x) for x in (e.extra or [])] or None)
     return e
 
 
@@ -1134,8 +1165,47 @@ def _erepr(e) -> str:
     return "(" + "|".join(parts) + ")"
 
 
-def _selectivity(pred: ir.Expr) -> float:
+def _hist_selectivity(pred: ir.Cmp, hist: dict):
+    """Range selectivity from an equi-height histogram, or None when
+    the predicate/column has no histogram (≙ ObOptSelectivity range
+    selectivity over ObOptColumnStat buckets)."""
+    import numpy as np
+
+    l, r, op = pred.left, pred.right, pred.op
+    if isinstance(l, ir.Literal) and isinstance(r, ir.ColumnRef):
+        l, r = r, l
+        op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+    if not (isinstance(l, ir.ColumnRef) and isinstance(r, ir.Literal)):
+        return None
+    if op not in ("<", "<=", ">", ">="):
+        return None  # =, != keep the NDV-based defaults
+    entry = (hist or {}).get(l.name)
+    if entry is None:
+        return None
+    edges, null_frac, coltype = entry
+    try:
+        from oceanbase_tpu.expr.compile import literal_value
+        from oceanbase_tpu.sql.session import _coerce_value
+
+        v, t = literal_value(r)
+        v = _coerce_value(v, t, coltype)
+    except Exception:
+        return None
+    if v is None or isinstance(v, str):
+        return None
+    k = len(edges) - 1
+    frac = float(np.searchsorted(
+        edges, v, side="right" if op in ("<=", ">") else "left")) / k
+    if op in (">", ">="):
+        frac = 1.0 - frac
+    return float(min(max(frac * (1.0 - null_frac), 0.001), 1.0))
+
+
+def _selectivity(pred: ir.Expr, hist: dict | None = None) -> float:
     if isinstance(pred, ir.Cmp):
+        hs = _hist_selectivity(pred, hist)
+        if hs is not None:
+            return hs
         return 0.1 if pred.op == "=" else 0.4
     if isinstance(pred, ir.InList):
         return min(0.9, 0.1 * max(len(pred.values), 1))
@@ -1145,9 +1215,9 @@ def _selectivity(pred: ir.Expr) -> float:
         s = 1.0
         if pred.op == "and":
             for a in pred.args:
-                s *= _selectivity(a)
+                s *= _selectivity(a, hist)
         else:
-            s = min(1.0, sum(_selectivity(a) for a in pred.args))
+            s = min(1.0, sum(_selectivity(a, hist) for a in pred.args))
         return s
     return 0.5
 
@@ -1209,8 +1279,8 @@ def _bind_conjunct_bound(self: Binder, bound: ir.Expr, qb: QueryBlock):
         f = qb.fragments[i]
         qb.fragments[i] = Fragment(
             pp.Filter(f.plan, bound), f.cols,
-            max(1, int(f.est_rows * _selectivity(bound))), f.unique_cols,
-            colids=f.colids, ndv=f.ndv,
+            max(1, int(f.est_rows * _selectivity(bound, f.hist))),
+            f.unique_cols, colids=f.colids, ndv=f.ndv, hist=f.hist,
         )
     else:
         qb.post_preds.append(bound)

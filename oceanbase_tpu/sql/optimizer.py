@@ -1,15 +1,20 @@
-"""Join-order optimizer: greedy connected smallest-first tree builder.
+"""Join-order optimizer: DP enumeration with a greedy fallback.
 
 Reference analog: the CBO join-order enumeration (src/sql/optimizer —
 ObJoinOrder with DP/IDP enumeration, ob_join_order_enum_idp.cpp) and the
-cost model (ObOptEstCost).  Round-1 scope: greedy smallest-first over the
-equi-join graph with PK-awareness for cardinality propagation — the IDP
-enumerator slots in behind the same interface later.
+cost model (ObOptEstCost).  Left-deep Selinger DP over the equi-join
+graph for <= DP_MAX_RELS relations (TPC-H tops out at 8), minimizing the
+sum of intermediate cardinalities with NDV/PK-aware join estimates;
+beyond that, greedy by smallest estimated OUTPUT (not input — joining a
+low-NDV edge early can be catastrophically worse than a bigger PK join,
+see TPC-H Q5).
 
 Static capacities (the TPU twist): every join gets an out_capacity budget
 derived from the cardinality estimate; underestimates surface as
 CapacityOverflow at runtime and the session retries with a larger budget
 (≙ the reference spilling to disk where we re-plan, SURVEY §7 hard (a)).
+Capacities clamp at CAP_MAX: a bigger buffer could never materialize —
+the overflow routes to the disk-spill tier instead of an int32 crash.
 """
 
 from __future__ import annotations
@@ -17,12 +22,38 @@ from __future__ import annotations
 from oceanbase_tpu.exec import plan as pp
 from oceanbase_tpu.expr import ir
 
+DP_MAX_RELS = 10
+CAP_MAX = 1 << 28  # rows; beyond this the spill tier is the answer
+
 
 def _pow2(n: int) -> int:
     p = 1
     while p < max(1, n):
         p <<= 1
-    return p
+    return min(p, CAP_MAX)
+
+
+def _join_out_est(est: int, tree_ndv: dict, f, keys) -> int:
+    """|T ⋈ f| estimate: PK join keeps the probe side; otherwise the
+    classic |L|·|R| / max(ndv(k)) with NDV from ANALYZE stats
+    (≙ ObOptEstCost join selectivity)."""
+    rkeys = [k[1] for k in keys]
+    rkey_cols = {k.name for k in rkeys if isinstance(k, ir.ColumnRef)}
+    if keys and rkey_cols & set(f.unique_cols):
+        return est
+    if not keys:
+        return min(est * max(f.est_rows, 1), 1 << 62)
+    ndvs = []
+    for lk, rk in keys:
+        if isinstance(lk, ir.ColumnRef) and lk.name in tree_ndv:
+            ndvs.append(tree_ndv[lk.name])
+        if isinstance(rk, ir.ColumnRef) and rk.name in f.ndv:
+            ndvs.append(f.ndv[rk.name])
+    if ndvs:
+        out = max(1, est * max(f.est_rows, 1) // max(ndvs))
+        # keep headroom: estimates are approximate
+        return max(out, est // 2, f.est_rows // 2)
+    return max(est * 2, f.est_rows)
 
 
 def build_join_tree(qb, catalog, capacity_factor: float = 1.5):
@@ -32,6 +63,10 @@ def build_join_tree(qb, catalog, capacity_factor: float = 1.5):
     if not frags:
         raise ValueError("empty FROM")
     n = len(frags)
+    colid_frag = {}
+    for i, f in enumerate(frags):
+        for c in f.colids:
+            colid_frag[c] = i
     if n == 1:
         f = frags[0]
         return f.plan, f.est_rows, {c: 0 for c in f.colids}
@@ -42,71 +77,106 @@ def build_join_tree(qb, catalog, capacity_factor: float = 1.5):
         edges[fi].setdefault(fj, []).append((le, re_))
         edges[fj].setdefault(fi, []).append((re_, le))
 
-    remaining = set(range(n))
-    # start from the largest (fact) table: it stays the probe side, so
-    # PK-joins against dimensions keep capacity = probe rows
-    start = max(remaining, key=lambda i: frags[i].est_rows)
-    joined = {start}
-    remaining.discard(start)
-    plan = frags[start].plan
-    est = frags[start].est_rows
-    tree_ndv: dict = dict(frags[start].ndv)
+    order = None
+    if n <= DP_MAX_RELS:
+        order = _dp_order(frags, edges, n)
+    if order is None:
+        order = _greedy_order(frags, edges, n)
 
-    def edge_keys(i):
-        keys = []
-        for j in joined:
-            for le, re_ in edges[j].get(i, []):
-                keys.append((le, re_))
-        return keys
-
-    while remaining:
-        candidates = [i for i in remaining if edge_keys(i)]
-        if not candidates:
-            candidates = list(remaining)  # cross join fallback
-        nxt = min(candidates, key=lambda i: frags[i].est_rows)
-        keys = edge_keys(nxt)
-        f = frags[nxt]
-        lkeys = [k[0] for k in keys]
-        rkeys = [k[1] for k in keys]
-        # cardinality: PK join keeps probe side; otherwise the classic
-        # |L ⋈ R| ≈ |L|·|R| / max(ndv_L(k), ndv_R(k)) with NDV from
-        # ANALYZE stats (≙ ObOptEstCost join selectivity)
-        rkey_cols = {k.name for k in rkeys if isinstance(k, ir.ColumnRef)}
-        if keys and rkey_cols & set(f.unique_cols):
-            out_est = est
-        elif not keys:
-            out_est = est * max(f.est_rows, 1)
-        else:
-            ndvs = []
-            for lk, rk in keys:
-                if isinstance(lk, ir.ColumnRef) and lk.name in tree_ndv:
-                    ndvs.append(tree_ndv[lk.name])
-                if isinstance(rk, ir.ColumnRef) and rk.name in f.ndv:
-                    ndvs.append(f.ndv[rk.name])
-            if ndvs:
-                out_est = max(1, est * max(f.est_rows, 1) // max(ndvs))
-                # keep headroom: estimates are approximate
-                out_est = max(out_est, est // 2, f.est_rows // 2)
-            else:
-                out_est = max(est * 2, f.est_rows)
-        cap = _pow2(int(out_est * capacity_factor) + 16)
-        plan = pp.HashJoin(plan, f.plan, lkeys, rkeys, how="inner",
-                           out_capacity=cap)
+    plan, est, tree_ndv = None, 0, {}
+    joined: set[int] = set()
+    for idx in order:
+        f = frags[idx]
+        if plan is None:
+            plan, est, tree_ndv = f.plan, f.est_rows, dict(f.ndv)
+            joined.add(idx)
+            continue
+        keys = _edge_keys(edges, joined, idx)
+        out_est = _join_out_est(est, tree_ndv, f, keys)
+        cap = _pow2(int(min(out_est, CAP_MAX) * capacity_factor) + 16)
+        plan = pp.HashJoin(plan, f.plan,
+                           [k[0] for k in keys], [k[1] for k in keys],
+                           how="inner", out_capacity=cap)
         est = max(1, out_est)
         tree_ndv.update(f.ndv)
+        joined.add(idx)
+    return plan, est, colid_frag
+
+
+def _edge_keys(edges, joined: set, i: int):
+    keys = []
+    for j in joined:
+        for le, re_ in edges[j].get(i, []):
+            keys.append((le, re_))
+    return keys
+
+
+def _greedy_order(frags, edges, n):
+    """Greedy: start at the largest (fact) table, then repeatedly join
+    the edged candidate with the smallest estimated OUTPUT."""
+    remaining = set(range(n))
+    start = max(remaining, key=lambda i: frags[i].est_rows)
+    order = [start]
+    joined = {start}
+    remaining.discard(start)
+    est = frags[start].est_rows
+    tree_ndv = dict(frags[start].ndv)
+    while remaining:
+        cands = [i for i in remaining if _edge_keys(edges, joined, i)]
+        if not cands:
+            cands = list(remaining)  # cross join fallback
+        scored = [(_join_out_est(est, tree_ndv, frags[i],
+                                 _edge_keys(edges, joined, i)), i)
+                  for i in cands]
+        out_est, nxt = min(scored)
+        order.append(nxt)
         joined.add(nxt)
         remaining.discard(nxt)
+        est = max(1, out_est)
+        tree_ndv.update(frags[nxt].ndv)
+    return order
 
-    colid_frag = {}
-    for i, f in enumerate(frags):
-        for c in f.colids:
-            colid_frag[c] = i
-    return plan, est, colid_frag
+
+def _dp_order(frags, edges, n):
+    """Left-deep Selinger DP over connected extensions: dp[mask] = the
+    cheapest (sum of intermediate cardinalities) join order covering
+    ``mask``.  Returns None when the graph needs a cross join (the
+    greedy fallback handles those).
+
+    ≙ ob_join_order_enum_idp.cpp — full DP at this width; IDP's
+    windowed re-optimization only matters past DP_MAX_RELS, where the
+    greedy path takes over."""
+    full = (1 << n) - 1
+    # dp[mask] -> (cost, est, ndv, order)
+    dp: dict[int, tuple] = {}
+    for i in range(n):
+        dp[1 << i] = (0, frags[i].est_rows, dict(frags[i].ndv), (i,))
+    for mask in range(1, full + 1):
+        if mask not in dp or mask == full:
+            continue
+        cost, est, ndv, order = dp[mask]
+        joined = {i for i in range(n) if mask & (1 << i)}
+        for i in range(n):
+            if mask & (1 << i):
+                continue
+            keys = _edge_keys(edges, joined, i)
+            if not keys:
+                continue
+            out_est = _join_out_est(est, ndv, frags[i], keys)
+            ncost = cost + out_est
+            nmask = mask | (1 << i)
+            cur = dp.get(nmask)
+            if cur is None or ncost < cur[0]:
+                nndv = dict(ndv)
+                nndv.update(frags[i].ndv)
+                dp[nmask] = (ncost, max(1, out_est), nndv, order + (i,))
+    hit = dp.get(full)
+    return None if hit is None else list(hit[3])
 
 
 def scale_capacities(node: pp.PlanNode, factor: int) -> pp.PlanNode:
     """Rebuild a plan with all static capacities multiplied (retry path
-    after CapacityOverflow)."""
+    after CapacityOverflow); clamped at CAP_MAX."""
     import dataclasses
 
     kids = {}
@@ -117,7 +187,7 @@ def scale_capacities(node: pp.PlanNode, factor: int) -> pp.PlanNode:
         kids["inputs"] = [scale_capacities(c, factor) for c in node.inputs]
     updates = dict(kids)
     if hasattr(node, "out_capacity") and node.out_capacity is not None:
-        updates["out_capacity"] = node.out_capacity * factor
+        updates["out_capacity"] = min(node.out_capacity * factor, CAP_MAX)
     if not updates:
         return node
     return dataclasses.replace(node, **updates)

@@ -112,6 +112,16 @@ class Parser:
                 self.next()
                 return ast.TenantStmt("create", self.expect_ident())
             if self.peek(1).kind == "ident" and \
+                    self.peek(1).value == "user":
+                self.next()
+                self.next()
+                name = self._user_name()
+                pw = ""
+                if self._accept_word("identified"):
+                    self.expect_kw("by")
+                    pw = self._string_lit()
+                return ast.UserStmt("create", name, pw)
+            if self.peek(1).kind == "ident" and \
                     self.peek(1).value == "sequence":
                 return self.parse_sequence("create")
             return self.parse_create()
@@ -120,6 +130,11 @@ class Parser:
                 self.next()
                 self.next()
                 return ast.TenantStmt("drop", self.expect_ident())
+            if self.peek(1).kind == "ident" and \
+                    self.peek(1).value == "user":
+                self.next()
+                self.next()
+                return ast.UserStmt("drop", self._user_name())
             if self.peek(1).kind == "ident" and \
                     self.peek(1).value == "sequence":
                 self.next()
@@ -331,6 +346,11 @@ class Parser:
                 self.accept_kw("outer")
                 self.expect_kw("join")
                 kind = "right"
+            elif self.at_kw("full"):
+                self.next()
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                kind = "full"
             else:
                 break
             right = self.parse_table_primary()
@@ -707,11 +727,86 @@ class Parser:
                 order_by.append((e, asc))
                 if not self.accept_op(","):
                     break
+        frame = None
+        w = self._accept_word("rows", "range")
+        if w:
+            frame = self.parse_frame(w)
         self.expect_op(")")
         if name == "count" and not args:
             name = "count_star"
-        return ir.WindowCall(name, args[0] if args else None,
-                             partition_by, order_by)
+        extra = None
+        arg = args[0] if args else None
+        if name in ("lead", "lag"):
+            extra = args[1:3]  # (offset, default)
+        elif name == "ntile":
+            arg, extra = None, args[:1]
+        return ir.WindowCall(name, arg, partition_by, order_by,
+                             frame=frame, extra=extra)
+
+    def _user_name(self) -> str:
+        """username as identifier or 'quoted' string ('u'@'host'
+        accepted, host ignored — single-host deployment)."""
+        t = self.next()
+        if t.kind not in ("ident", "string"):
+            raise ParseError(f"expected user name at {t.pos}")
+        name = t.value
+        if self.accept_op("@"):
+            self.next()  # host part, ignored
+        return name
+
+    def _string_lit(self) -> str:
+        t = self.next()
+        if t.kind != "string":
+            raise ParseError(f"expected string literal at {t.pos}")
+        return t.value
+
+    def _accept_word(self, *words) -> Optional[str]:
+        """Accept a keyword-or-identifier token by its text (frame-clause
+        words aren't reserved in the lexer)."""
+        t = self.peek()
+        if t.kind in ("kw", "ident") and t.value in words:
+            return self.next().value
+        return None
+
+    def parse_frame(self, unit: str) -> tuple:
+        """ROWS/RANGE frame clause -> (unit, start, end); offsets are
+        row-relative ints, None = UNBOUNDED on that side."""
+
+        def bound():
+            if self._accept_word("unbounded"):
+                if not self._accept_word("preceding", "following"):
+                    raise ParseError("expected PRECEDING/FOLLOWING")
+                return None
+            if self._accept_word("current"):
+                if not self._accept_word("row"):
+                    raise ParseError("expected ROW")
+                return 0
+            e = self.parse_expr()
+            if not isinstance(e, ir.Literal) or \
+                    not isinstance(e.value, int):
+                raise ParseError("frame offset must be an integer")
+            k = int(e.value)
+            w = self._accept_word("preceding", "following")
+            if w == "preceding":
+                return -k
+            if w == "following":
+                return k
+            raise ParseError("expected PRECEDING/FOLLOWING")
+
+        if self._accept_word("between"):
+            s = bound()
+            self.expect_kw("and")
+            e = bound()
+        else:
+            s = bound()
+            e = 0
+        if unit == "range" and s in (None, 0) and e == 0:
+            return None  # the default frame — not a restriction
+        if unit == "range":
+            raise ParseError(
+                "only ROWS frames (or the default RANGE frame) "
+                "are supported")
+        return (unit, s, e)
 
     # ---- types / DDL / DML -------------------------------------------------
     def parse_type(self) -> SqlType:
@@ -760,6 +855,13 @@ class Parser:
 
     def parse_set(self):
         self.expect_kw("set")
+        if self._accept_word("password"):
+            # SET PASSWORD FOR user = 'pw'
+            if not self._accept_word("for"):
+                raise ParseError("SET PASSWORD requires FOR <user>")
+            name = self._user_name()
+            self.expect_op("=")
+            return ast.UserStmt("set_password", name, self._string_lit())
         scope = "session"
         if self.accept_kw("global"):
             scope = "global"

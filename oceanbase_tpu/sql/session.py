@@ -266,6 +266,16 @@ class Session:
             else:
                 self.db.drop_tenant(stmt.name)
             return _ok()
+        if isinstance(stmt, ast.UserStmt):
+            if self.db is None:
+                raise NotImplementedError("users need a Database")
+            if stmt.op == "create":
+                self.db.create_user(stmt.name, stmt.password)
+            elif stmt.op == "drop":
+                self.db.drop_user(stmt.name)
+            else:
+                self.db.set_password(stmt.name, stmt.password)
+            return _ok()
         if isinstance(stmt, ast.LoadDataStmt):
             return self._load_data(stmt)
         if isinstance(stmt, ast.TruncateStmt):
@@ -585,9 +595,12 @@ class Session:
             if l0 >= int(self.tenant.config["minor_compact_trigger"]):
                 self._engine.minor_compact(table)
 
+    HIST_BUCKETS = 64
+
     def _analyze(self, stmt: ast.AnalyzeStmt) -> Result:
-        """Refresh optimizer stats (row counts + NDV) for a table
-        (≙ DBMS_STATS gather, src/share/stat)."""
+        """Refresh optimizer stats for a table: row count, NDV, and
+        equi-height histograms for non-string columns
+        (≙ DBMS_STATS gather, src/share/stat/ob_opt_column_stat.h)."""
         td = self.catalog.table_def(stmt.table)
         rel = self.catalog.table_data(stmt.table)
         import numpy as _np
@@ -601,9 +614,23 @@ class Session:
                 continue
             if col.sdict is not None:
                 td.ndv[c.name] = col.sdict.size
+                continue
+            data = _np.asarray(col.data)[mask]
+            if col.valid is not None:
+                v = _np.asarray(col.valid)[mask]
+                null_frac = 1.0 - (v.sum() / max(len(v), 1))
+                data = data[v]
             else:
-                data = _np.asarray(col.data)[mask]
-                td.ndv[c.name] = int(len(_np.unique(data))) if n else 1
+                null_frac = 0.0
+            td.ndv[c.name] = int(len(_np.unique(data))) if len(data) else 1
+            if len(data) >= self.HIST_BUCKETS and data.dtype.kind in "iuf":
+                qs = _np.linspace(0, 100, self.HIST_BUCKETS + 1)
+                edges = _np.percentile(data, qs)
+                td.histograms[c.name] = (edges, float(null_frac))
+            else:
+                # the column no longer qualifies: stale edges must not
+                # keep feeding selectivity after a successful ANALYZE
+                td.histograms.pop(c.name, None)
         return _ok()
 
     # ------------------------------------------------------------------
@@ -673,6 +700,7 @@ class Session:
         dop = self._px_dop()
         factor = 1
         t0 = time.time()
+        self._last_px = False  # did the last query run through PX?
         for attempt in range(int(self.variables["max_capacity_retry"]) + 1):
             try:
                 p = plan if factor == 1 else scale_capacities(plan, factor)
@@ -680,6 +708,7 @@ class Session:
                 if dop > 1:
                     rel = self._try_px(p, tables, dop, factor=factor,
                                        monitor=monitor)
+                    self._last_px = rel is not None
                 if rel is None:
                     rel = execute_plan(p, tables, monitor_out=monitor)
                 break
@@ -1292,6 +1321,15 @@ class Session:
             kv = KvTable(self.tenant, stmt.table)
 
         def op(tx):
+            if not replace and self._pdml_eligible(len(rows_values)):
+                keyed = [(tablet.make_key(v), v) for v in rows_values]
+                if len({k for k, _ in keyed}) == len(keyed):
+                    # distinct keys: the write phase is order-free, fan
+                    # it out (intra-statement dup keys need serial
+                    # first-wins ordering)
+                    self._pdml_write(tx, stmt.table, tablet, keyed,
+                                     "insert")
+                    return
             for values in rows_values:
                 key = tablet.make_key(values)
                 kind = "insert"
@@ -1311,6 +1349,51 @@ class Session:
         self.catalog.invalidate(stmt.table)
         self._maybe_freeze(stmt.table)
         return _ok(rowcount=len(rows_values))
+
+    # ------------------------------------------------------------------
+    # parallel DML (≙ src/sql/engine/pdml: partition-aware parallel
+    # insert/update/delete DFOs under ONE transaction)
+    # ------------------------------------------------------------------
+    def _pdml_eligible(self, n_rows: int) -> bool:
+        return (self.tenant is not None and self.db is not None
+                and int(self.db.config["pdml_dop"]) > 1
+                and n_rows >= int(self.db.config["pdml_min_rows"]))
+
+    def _pdml_write(self, tx, table: str, tablet, keyed: list,
+                    kind: str):
+        """Fan the write phase of one statement out over tenant workers.
+
+        keyed: [(key, values)].  Rows group by target partition so each
+        worker owns whole partitions (no cross-worker tablet contention;
+        ≙ the PDML repartition by PKEY, ob_sub_trans_ctrl.h); an
+        unpartitioned tablet falls back to round-robin chunks (its
+        memtable writes serialize on the tablet lock, but index
+        maintenance and redo encoding still parallelize)."""
+        dop = int(self.db.config["pdml_dop"])
+        groups: dict[int, list] = {}
+        if hasattr(tablet, "route_partition_index"):
+            for key, values in keyed:
+                groups.setdefault(
+                    tablet.route_partition_index(values), []).append(
+                        (key, values))
+        else:
+            for i, kv_ in enumerate(keyed):
+                groups.setdefault(i % dop, []).append(kv_)
+
+        def worker(batch):
+            for key, values in batch:
+                self._txsvc.write(tx, table, tablet, key, kind, values)
+
+        futures = [self.tenant.submit(worker, batch)
+                   for batch in groups.values()]
+        errs = []
+        for f in futures:
+            try:
+                f.result()
+            except Exception as e:  # noqa: BLE001 — surface first error
+                errs.append(e)
+        if errs:
+            raise errs[0]
 
     def _fill_auto_increment(self, td, values: dict):
         if self.tenant is None:
@@ -1410,6 +1493,7 @@ class Session:
             any(c == part_col for c, _ in stmt.assignments)
 
         def op(tx):
+            keyed = []
             for i in range(n_upd):
                 old_values = {}
                 for c in tablet.columns:
@@ -1425,6 +1509,17 @@ class Session:
                     values[cname] = (None if not vv[i]
                                      else (x.item() if hasattr(x, "item")
                                            else x))
+                keyed.append((old_values, values))
+            if not key_changed and not part_changed and \
+                    self._pdml_eligible(n_upd):
+                # plain (no PK/partition move) bulk update: per-row
+                # target keys are distinct, the write phase fans out
+                self._pdml_write(
+                    tx, stmt.table, tablet,
+                    [(tuple(v[k] for k in tablet.key_cols), v)
+                     for _o, v in keyed], "update")
+                return
+            for old_values, values in keyed:
                 new_key = tuple(values[k] for k in tablet.key_cols)
                 moved = False
                 if part_changed:
@@ -1463,6 +1558,7 @@ class Session:
         n_del = len(next(iter(matched.values()))) if matched else 0
 
         def op(tx):
+            keyed = []
             for i in range(n_del):
                 values = {}
                 for c in tablet.columns:
@@ -1472,9 +1568,14 @@ class Session:
                         values[c] = (None if vd is not None and not vd[i]
                                      else (x.item() if hasattr(x, "item")
                                            else x))
-                key = tuple(values[k] for k in tablet.key_cols)
+                keyed.append((tuple(values[k] for k in tablet.key_cols),
+                              values))
+            if self._pdml_eligible(n_del):
+                self._pdml_write(tx, stmt.table, tablet, keyed, "delete")
+                return
+            for key, values in keyed:
                 self._txsvc.write(tx, stmt.table, tablet, key, "delete",
-                                 values)
+                                  values)
 
         self._run_in_tx(op, tx_hint=tx_hint)
         self.catalog.invalidate(stmt.table)

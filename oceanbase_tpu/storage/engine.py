@@ -50,6 +50,10 @@ class StorageEngine:
         self.truncate_barriers: dict[str, int] = {}
         self._lock = threading.RLock()
         self._slog_f = None
+        # multi-node hook: logical DDL ops also replicate through the
+        # tenant's log stream (net/node.py wires this; followers apply
+        # via _replay) — physical segment ops stay node-local
+        self.ddl_wal_cb = None
         if root is not None:
             os.makedirs(os.path.join(root, "segments"), exist_ok=True)
             self._open_or_recover()
@@ -64,6 +68,8 @@ class StorageEngine:
         return os.path.join(self.root, "manifest.json")
 
     def _log_meta(self, op: dict):
+        if self.ddl_wal_cb is not None:
+            self.ddl_wal_cb(op)
         if self.root is None:
             return
         if self._slog_f is None:
@@ -721,14 +727,37 @@ class StorageCatalog(Catalog):
 
     def drop_table(self, name: str, if_exists: bool = False):
         with self._lock:
-            if name not in self._defs:
+            if name not in self._defs and name not in self.engine.tables:
                 if if_exists:
                     return
                 raise KeyError(name)
             self.engine.drop_table(name)
-            del self._defs[name]
+            self._defs.pop(name, None)
             self._cache.pop(name, None)
             self.schema_version += 1
+
+    # -- engine is the source of truth for defs: WAL apply on a replica
+    # installs/drops tables behind the catalog's back (net/node.py) ------
+    def table_def(self, name: str):
+        with self._lock:
+            t = self._transients.get(name)
+            if t is not None:
+                return t[0]
+            ts = self.engine.tables.get(name)
+            if ts is not None:
+                self._defs[name] = ts.tdef
+                return ts.tdef
+            self._defs.pop(name, None)
+            raise KeyError(f"unknown table {name}")
+
+    def has_table(self, name: str) -> bool:
+        with self._lock:
+            return name in self._transients or name in self.engine.tables
+
+    def tables(self) -> list[str]:
+        with self._lock:
+            return sorted(n for n in self.engine.tables
+                          if not n.startswith("__idx__"))
 
     def load_numpy(self, name, arrays, types=None, primary_key=None,
                    valids=None):

@@ -64,11 +64,18 @@ class Transaction:
     # huge transactions — incremental pre-commit flush is an r2 item.
     pending_redo: list = field(default_factory=list)
 
+    # parallel-DML workers write under one tx concurrently; participant
+    # creation must not race (keys lists are append-only, GIL-atomic)
+    plock: threading.Lock = field(default_factory=threading.Lock)
+
     def participant(self, table: str, tablet) -> Participant:
         p = self.participants.get(table)
         if p is None:
-            p = Participant(table, tablet)
-            self.participants[table] = p
+            with self.plock:
+                p = self.participants.get(table)
+                if p is None:
+                    p = Participant(table, tablet)
+                    self.participants[table] = p
         return p
 
 
@@ -241,11 +248,14 @@ class TransService:
     # recovery (≙ replayservice applying committed log to memtables)
     # ------------------------------------------------------------------
     @staticmethod
-    def replay(entries, engine):
+    def replay(entries, engine, pending: dict | None = None):
         """Replay committed WAL records into a StorageEngine's memtables.
         Redo is buffered per tx and applied at its commit record, matching
-        commit-version visibility."""
-        pending: dict[int, list] = {}
+        commit-version visibility.  ``pending`` carries the redo buffer
+        across incremental calls (follower apply streams one entry at a
+        time, ≙ replayservice applying as committed_lsn advances)."""
+        if pending is None:
+            pending = {}
         max_ts = 0
         for e in entries:
             try:
@@ -253,7 +263,13 @@ class TransService:
             except Exception:
                 continue
             op = rec.get("op")
-            if op == "redo":
+            if op == "ddl":
+                # replicated logical DDL (multi-node log stream).  Apply
+                # idempotently vs slog-applied state: the originator's
+                # own slog may already hold the op (boot replays slog
+                # first, then the WAL suffix).
+                _replay_ddl(rec["slog"], engine)
+            elif op == "redo":
                 pending.setdefault(rec["tx"], []).append(rec)
             elif op == "commit":
                 version = rec["version"]
@@ -296,6 +312,30 @@ class TransService:
                 for recs in pending.values():
                     recs[:] = [r for r in recs if r["table"] not in tset]
         return max_ts
+
+
+def _replay_ddl(op: dict, engine):
+    """Apply one replicated DDL op, skipping anything the engine's own
+    slog already applied (create/drop/alter become no-ops when the
+    target state is already present — WAL DDL replay must never wipe
+    slog-restored segments, e.g. a CTAS bulk load with no redo)."""
+    kind = op.get("op")
+    if kind in ("create_table", "drop_table"):
+        exists = op.get("name") in engine.tables
+        if (kind == "create_table" and exists) or \
+                (kind == "drop_table" and not exists):
+            return
+    elif kind in ("alter_add", "alter_drop"):
+        ts = engine.tables.get(op.get("table"))
+        if ts is not None:
+            cname = (op["column"][0] if kind == "alter_add"
+                     else op.get("column"))
+            has = any(c.name == cname for c in ts.tdef.columns)
+            if (kind == "alter_add" and has) or \
+                    (kind == "alter_drop" and not has):
+                return
+    # create_index/drop_index/truncate: engine._replay is idempotent
+    engine._replay(op)
 
 
 def _jsonable(values: dict) -> dict:

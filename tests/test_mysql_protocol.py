@@ -15,9 +15,11 @@ from oceanbase_tpu.server.mysql_protocol import MySQLServer
 class MiniClient:
     """Just enough of the 4.1 text protocol to drive the server."""
 
-    def __init__(self, host, port):
+    def __init__(self, host, port, user="root", password=""):
         self.sock = socket.create_connection((host, port), timeout=10)
         self.seq = 0
+        self.user = user
+        self.password = password
         self._handshake()
 
     def _read_packet(self):
@@ -41,15 +43,33 @@ class MiniClient:
         self.seq += 1
 
     def _handshake(self):
+        import hashlib
+
         greeting = self._read_packet()
         assert greeting[0] == 0x0A
         ver = greeting[1:greeting.index(b"\x00", 1)]
         assert b"oceanbase-tpu" in ver
+        # salt: 8 bytes after ver+thread id, 12 more later
+        p = greeting.index(b"\x00", 1) + 1 + 4
+        salt = greeting[p:p + 8]
+        rest = greeting[p + 8 + 1 + 2 + 1 + 2 + 2 + 1 + 10:]
+        salt += rest[:rest.index(b"\x00")]
+        if self.password:
+            sha_pw = hashlib.sha1(self.password.encode()).digest()
+            stage2 = hashlib.sha1(sha_pw).digest()
+            mask = hashlib.sha1(salt[:20] + stage2).digest()
+            token = bytes(a ^ b for a, b in zip(sha_pw, mask))
+        else:
+            token = b""
         caps = 0x0200 | 0x8000  # PROTOCOL_41 | SECURE_CONNECTION
         resp = (struct.pack("<IIB", caps, 1 << 24, 0x21) + b"\x00" * 23 +
-                b"root\x00" + b"\x00")
+                self.user.encode() + b"\x00" +
+                bytes([len(token)]) + token)
         self._send(resp)
         ok = self._read_packet()
+        if ok[0] == 0xFF:
+            code = struct.unpack_from("<H", ok, 1)[0]
+            raise PermissionError(f"auth failed: {code}")
         assert ok[0] == 0x00, ok
 
     @staticmethod
@@ -207,4 +227,49 @@ def test_wire_two_concurrent_sessions(server):
     c1.query("commit")
     assert c2.query("select v from s")["rows"] == [("2",)]
     c1.close()
+    c2.close()
+
+
+def test_auth_rejects_bad_password(server):
+    c = MiniClient(server.host, server.port)
+    assert c.query("create user alice identified by 'secret'")["ok"]
+    c.close()
+    # correct password authenticates
+    c2 = MiniClient(server.host, server.port, user="alice",
+                    password="secret")
+    assert c2.ping()
+    c2.close()
+    # wrong password rejected with 1045
+    with pytest.raises(PermissionError):
+        MiniClient(server.host, server.port, user="alice",
+                   password="wrong")
+    # unknown user rejected
+    with pytest.raises(PermissionError):
+        MiniClient(server.host, server.port, user="mallory",
+                   password="x")
+    # root with a bogus password (it expects empty) rejected
+    with pytest.raises(PermissionError):
+        MiniClient(server.host, server.port, user="root",
+                   password="nope")
+
+
+def test_auth_persists_across_restart(server, tmp_path):
+    c = MiniClient(server.host, server.port)
+    c.query("create user bob identified by 'pw1'")
+    c.close()
+    db2 = Database(server.database.root)
+    assert "bob" in db2.users
+    db2.close()
+
+
+def test_set_password(server):
+    c = MiniClient(server.host, server.port)
+    c.query("create user carol identified by 'old'")
+    c.query("set password for carol = 'new'")
+    c.close()
+    with pytest.raises(PermissionError):
+        MiniClient(server.host, server.port, user="carol", password="old")
+    c2 = MiniClient(server.host, server.port, user="carol",
+                    password="new")
+    assert c2.ping()
     c2.close()

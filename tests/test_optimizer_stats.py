@@ -1,0 +1,119 @@
+"""Optimizer depth: DP join enumeration + histogram selectivity
+(VERDICT r3 item #8).
+
+≙ src/sql/optimizer/ob_join_order_enum_idp.cpp (enumeration) and
+src/share/stat/ob_opt_column_stat.h (equi-height histograms).
+"""
+
+import numpy as np
+
+from oceanbase_tpu.sql import Session
+from oceanbase_tpu.sql.binder import Binder
+from oceanbase_tpu.sql.parser import Parser
+
+
+def _est(sess, sql):
+    b = Binder(sess.catalog)
+    _plan, _outs, est = b.bind_select(Parser(sql).parse())
+    return est
+
+
+def test_histogram_improves_range_estimates():
+    rng = np.random.default_rng(0)
+    n = 20_000
+    v = np.where(rng.random(n) < 0.99, rng.integers(0, 100, n),
+                 rng.integers(100, 10_000, n))
+    s = Session()
+    s.catalog.load_numpy("t", {"k": np.arange(n), "v": v},
+                         primary_key=["k"])
+    before = _est(s, "select k from t where v >= 5000")
+    s.execute("analyze table t")
+    after = _est(s, "select k from t where v >= 5000")
+    true = int((v >= 5000).sum())
+    assert abs(after - true) < abs(before - true)
+    # the low-range estimate moves the other way
+    lo = _est(s, "select k from t where v < 100")
+    assert lo > n // 2
+
+
+def test_dp_join_order_avoids_low_ndv_edge_first():
+    """Q5-shaped trap: joining the low-NDV nationkey edge before the PK
+    orders edge explodes the intermediate; DP must order orders before
+    customer."""
+    from oceanbase_tpu.exec import plan as pp
+
+    rng = np.random.default_rng(1)
+    n_li, n_ord, n_cust = 50_000, 12_000, 1500
+    s = Session()
+    s.catalog.load_numpy("li", {
+        "l_ok": rng.integers(0, n_ord, n_li),
+        "l_sk": rng.integers(0, 100, n_li)}, primary_key=[])
+    s.catalog.load_numpy("ord", {
+        "o_ok": np.arange(n_ord),
+        "o_ck": rng.integers(0, n_cust, n_ord)}, primary_key=["o_ok"])
+    s.catalog.load_numpy("cust", {
+        "c_ck": np.arange(n_cust),
+        "c_nk": rng.integers(0, 25, n_cust)}, primary_key=["c_ck"])
+    s.catalog.load_numpy("supp", {
+        "s_sk": np.arange(100),
+        "s_nk": rng.integers(0, 25, 100)}, primary_key=["s_sk"])
+    sql = ("select count(*) from li, ord, cust, supp "
+           "where l_ok = o_ok and o_ck = c_ck and l_sk = s_sk "
+           "and c_nk = s_nk")
+    b = Binder(s.catalog)
+    plan, _outs, est = b.bind_select(Parser(sql).parse())
+
+    # walk the join tree: the nationkey-only join (cust joined with only
+    # the c_nk = s_nk edge available) must not appear — every join of
+    # cust must include the o_ck = c_ck PK edge
+    def joins(node):
+        if isinstance(node, pp.HashJoin):
+            yield node
+            yield from joins(node.left)
+            yield from joins(node.right)
+        else:
+            for f in ("child", "left", "right"):
+                k = getattr(node, f, None)
+                if k is not None:
+                    yield from joins(k)
+
+    for j in joins(plan):
+        keys = {k.name for k in j.right_keys
+                if hasattr(k, "name")}
+        if "c_ck" in keys or "c_nk" in keys:
+            assert "c_ck" in keys, (
+                "customer joined by nationkey only — the DP order "
+                f"regressed (keys={keys})")
+    # the overall estimate stays near |li|, not the nationkey blowup
+    assert est < n_li * 4
+
+
+def test_dp_plans_are_correct_vs_greedy():
+    rng = np.random.default_rng(2)
+    s = Session()
+    n = 3000
+    s.catalog.load_numpy("a", {"ak": np.arange(n),
+                               "aj": rng.integers(0, 50, n)},
+                         primary_key=["ak"])
+    s.catalog.load_numpy("b", {"bk": np.arange(50),
+                               "bv": rng.integers(0, 10, 50)},
+                         primary_key=["bk"])
+    s.catalog.load_numpy("c", {"ck": np.arange(10),
+                               "cv": rng.integers(0, 5, 10)},
+                         primary_key=["ck"])
+    sql = ("select count(*), sum(cv) from a, b, c "
+           "where aj = bk and bv = ck")
+    got = s.execute(sql).rows()[0]
+    import sqlite3
+
+    conn = sqlite3.connect(":memory:")
+    for nm in ("a", "b", "c"):
+        rel = s.catalog.table_data(nm)
+        cols = list(rel.columns)
+        conn.execute(f"create table {nm} ({', '.join(cols)})")
+        arrs = [np.asarray(rel.columns[c].data).tolist() for c in cols]
+        conn.executemany(
+            f"insert into {nm} values ({','.join('?' * len(cols))})",
+            list(zip(*arrs)))
+    want = conn.execute(sql).fetchone()
+    assert tuple(got) == tuple(want)

@@ -1,0 +1,102 @@
+"""Parallel DML: the write phase of big statements fans out over tenant
+workers under ONE transaction (VERDICT r3 item #6).
+
+≙ src/sql/engine/pdml (partition-aware parallel insert/update/delete
+DFOs) + ob_sub_trans_ctrl.h (sub-tasks under one tx).
+"""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.server import Database
+
+N = 30_000
+
+
+def _mk(tmp_path, threshold=1000, dop=4):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute(f"alter system set pdml_min_rows = {threshold}")
+    s.execute(f"alter system set pdml_dop = {dop}")
+    return db, s
+
+
+def test_pdml_insert_select_with_index_and_wal(tmp_path):
+    db, s = _mk(tmp_path)
+    s.execute("create table src (k int primary key, v int, g int)")
+    rows = ", ".join(f"({i}, {i * 3 % 997}, {i % 50})" for i in range(N))
+    s.execute(f"insert into src values {rows}")
+    s.execute("create table dst (k int primary key, v int, g int)")
+    s.execute("create index iv on dst (v)")
+    # the PDML path: INSERT ... SELECT over the threshold
+    r = s.execute("insert into dst select k, v, g from src")
+    assert r.rowcount == N
+    r = s.execute("select count(*), sum(v) from dst")
+    cnt, sv = r.rows()[0]
+    assert cnt == N and sv == sum(i * 3 % 997 for i in range(N))
+    # secondary index maintained by the parallel writers
+    r = s.execute("select count(*) from dst where v = 3")
+    exp = sum(1 for i in range(N) if i * 3 % 997 == 3)
+    assert r.rows()[0][0] == exp
+    # WAL intact: recovery rebuilds the same table
+    db.close()
+    db2 = Database(str(tmp_path / "db"))
+    s2 = db2.session()
+    r = s2.execute("select count(*), sum(v) from dst")
+    assert tuple(r.rows()[0]) == (cnt, sv)
+    db2.close()
+
+
+def test_pdml_insert_into_partitioned_table(tmp_path):
+    db, s = _mk(tmp_path)
+    s.execute("create table src (k int primary key, v int)")
+    rows = ", ".join(f"({i}, {i % 1000})" for i in range(N))
+    s.execute(f"insert into src values {rows}")
+    s.execute("create table pt (k int primary key, v int) "
+              "partition by range (k) ("
+              "partition p0 values less than (10000), "
+              "partition p1 values less than (20000), "
+              "partition p2 values less than maxvalue)")
+    s.execute("insert into pt select k, v from src")
+    r = s.execute("select count(*), sum(v) from pt")
+    assert tuple(r.rows()[0]) == (N, sum(i % 1000 for i in range(N)))
+    # per-partition routing kept rows where they belong
+    r = s.execute("select count(*) from pt where k < 10000")
+    assert r.rows()[0][0] == 10000
+    db.close()
+
+
+def test_pdml_bulk_update_and_delete(tmp_path):
+    db, s = _mk(tmp_path)
+    s.execute("create table t (k int primary key, v int, g int)")
+    rows = ", ".join(f"({i}, {i % 100}, {i % 7})" for i in range(N))
+    s.execute(f"insert into t values {rows}")
+    r = s.execute("update t set v = v + 1000 where g < 5")
+    n_upd = sum(1 for i in range(N) if i % 7 < 5)
+    assert r.rowcount == n_upd
+    r = s.execute("select sum(v) from t")
+    exp = sum((i % 100) + (1000 if i % 7 < 5 else 0) for i in range(N))
+    assert r.rows()[0][0] == exp
+    r = s.execute("delete from t where g = 6")
+    n_del = sum(1 for i in range(N) if i % 7 == 6)
+    assert r.rowcount == n_del
+    r = s.execute("select count(*) from t")
+    assert r.rows()[0][0] == N - n_del
+    db.close()
+
+
+def test_pdml_atomicity_on_failure(tmp_path):
+    db, s = _mk(tmp_path)
+    s.execute("create table src (k int primary key, v int)")
+    # duplicate target PKs WITHIN the payload -> serial path handles;
+    # here: dup against EXISTING rows must roll the whole statement back
+    rows = ", ".join(f"({i}, {i})" for i in range(5000))
+    s.execute(f"insert into src values {rows}")
+    s.execute("create table dst (k int primary key, v int)")
+    s.execute("insert into dst values (4999, -1)")
+    with pytest.raises(Exception):
+        s.execute("insert into dst select k, v from src")
+    r = s.execute("select count(*), sum(v) from dst")
+    # statement rolled back atomically: only the pre-existing row remains
+    assert tuple(r.rows()[0]) == (1, -1)
+    db.close()
