@@ -15,7 +15,7 @@ from typing import Optional
 
 import numpy as np
 
-from oceanbase_tpu.datatypes import SqlType
+from oceanbase_tpu.datatypes import SqlType, TypeKind
 from oceanbase_tpu.vector import Relation, from_numpy
 
 
@@ -59,6 +59,10 @@ class TableDef:
     partition: tuple | None = None
     auto_increment_cols: list = field(default_factory=list)
     indexes: list = field(default_factory=list)  # list[IndexDef]
+    # vector/fulltext indexes: name -> {"kind", "column", "metric"...}
+    # (runtime structures — IVF buckets, posting lists — rebuild lazily
+    # per data_version; ≙ INDEX_TYPE_VEC_* / INDEX_TYPE_FTS_* schemas)
+    aux_indexes: dict = field(default_factory=dict)
 
     def column(self, name: str) -> ColumnDef:
         for c in self.columns:
@@ -72,6 +76,25 @@ class TableDef:
     @property
     def column_names(self) -> list[str]:
         return [c.name for c in self.columns]
+
+
+def sampled_ndv(arr, n: int, sample: int = 8192) -> int:
+    """NDV estimate from a fixed-seed sample (load-time default stats;
+    ANALYZE refines with the exact count).  A saturating sample (few
+    distinct values) means a low-cardinality domain — report the sample
+    distinct count, not a scaled guess: nationkey-style columns must not
+    look like high-cardinality keys to the join-order cost model."""
+    import numpy as _np
+
+    if n == 0:
+        return 1
+    if n <= sample:
+        return max(1, int(len(_np.unique(arr[:n]))))
+    idx = _np.random.default_rng(0).choice(n, sample, replace=False)
+    d = int(len(_np.unique(arr[idx])))
+    if d <= sample // 2:
+        return max(d, 1)
+    return max(1, min(n, int(d * (n / sample))))
 
 
 class Catalog:
@@ -148,8 +171,10 @@ class Catalog:
             cols.append(ColumnDef(cname, col.dtype, nullable=col.valid is not None))
             if col.sdict is not None:
                 ndv[cname] = col.sdict.size
+            elif col.dtype.kind == TypeKind.VECTOR:
+                ndv[cname] = n
             else:
-                ndv[cname] = max(1, min(n, int(n ** 0.8)))
+                ndv[cname] = sampled_ndv(np.asarray(arrays[cname]), n)
         with self._lock:
             self._defs[name] = TableDef(
                 name, cols, primary_key=primary_key or [], row_count=n, ndv=ndv

@@ -40,6 +40,7 @@ class TypeKind(enum.Enum):
     DATE = "date"
     DATETIME = "datetime"
     STRING = "string"
+    VECTOR = "vector"      # fixed-dim float32 embedding (precision = dim)
     NULLTYPE = "null"      # type of the bare NULL literal
 
 
@@ -89,6 +90,12 @@ class SqlType:
         return SqlType(TypeKind.STRING)
 
     @staticmethod
+    def vector(dim: int) -> "SqlType":
+        """VECTOR(dim): per-row float32 embedding, Column.data [n, dim]
+        (≙ the vector data type feeding src/share/vector_index)."""
+        return SqlType(TypeKind.VECTOR, dim)
+
+    @staticmethod
     def null() -> "SqlType":
         return SqlType(TypeKind.NULLTYPE)
 
@@ -104,6 +111,7 @@ class SqlType:
             TypeKind.DATE: np.dtype(np.int32),
             TypeKind.DATETIME: np.dtype(np.int64),
             TypeKind.STRING: np.dtype(np.int32),   # dictionary codes
+            TypeKind.VECTOR: np.dtype(np.float32),
             TypeKind.NULLTYPE: np.dtype(np.int64),
         }[self.kind]
 

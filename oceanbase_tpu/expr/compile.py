@@ -208,7 +208,11 @@ def like_to_regex(pattern: str) -> str:
 
 def _tf(c: Column):
     v = c.valid_or_true()
-    return c.data & v, (~c.data) & v
+    d = c.data
+    if d.dtype != jnp.bool_:
+        # SQL truthiness of a numeric predicate (MATCH score, 0/1 ints)
+        d = d != 0
+    return d & v, (~d) & v
 
 
 # ---------------------------------------------------------------------------
@@ -621,6 +625,17 @@ def unregister_udf(name: str):
     _UDFS.pop(name.lower(), None)
 
 
+def parse_vector_text(s: str) -> np.ndarray:
+    """'[0.1, 0.2, ...]' -> float32 ndarray (the vector literal format)."""
+    body = s.strip()
+    if body.startswith("[") and body.endswith("]"):
+        body = body[1:-1]
+    if not body.strip():
+        return np.zeros(0, dtype=np.float32)
+    return np.asarray([float(x) for x in body.split(",")],
+                      dtype=np.float32)
+
+
 def _eval_func(e: ir.FuncCall, rel: Relation, n: int) -> Column:
     name = e.name.lower()
     if name in _UDFS:
@@ -639,6 +654,68 @@ def _eval_func(e: ir.FuncCall, rel: Relation, n: int) -> Column:
             else:
                 rt = SqlType.int_()
         return Column(jnp.asarray(data), valid, rt)
+    if name == "match_against":
+        # MATCH(col) AGAINST('terms'): token containment evaluated in
+        # the DICTIONARY domain — one host pass over distinct values
+        # builds the score LUT, then a device gather maps codes to
+        # scores.  ≙ the FTS inverted index consulted per term
+        # (src/storage/fts): the dictionary IS the term-space here.
+        import re as _re
+
+        c = eval_expr(e.args[0], rel)
+        terms = e.args[1].value if isinstance(e.args[1], ir.Literal) \
+            else ""
+        qtoks = [t for t in _re.split(r"\W+", str(terms).lower()) if t]
+        if c.sdict is None or not qtoks:
+            return Column(jnp.zeros(n, jnp.float64), c.valid,
+                          SqlType.double())
+
+        def score(text):
+            toks = set(_re.split(r"\W+", str(text).lower()))
+            return float(sum(1.0 for t in qtoks if t in toks))
+
+        lut = jnp.asarray(c.sdict.lut(score).astype(np.float64))
+        data = jnp.take(lut, jnp.clip(c.data, 0, c.sdict.size - 1))
+        if c.valid is not None:
+            data = jnp.where(c.valid, data, 0.0)
+        return Column(data, c.valid, SqlType.double())
+    if name in ("l2_distance", "inner_product", "negative_inner_product",
+                "cosine_distance"):
+        # vector distance over a VECTOR column and a '[...]' literal /
+        # second vector column (≙ the vector distance exprs feeding
+        # src/share/vector_index); [n,d] x [d] -> [n] double
+        def _vec_arg(x):
+            if isinstance(x, ir.Literal) and isinstance(x.value, str):
+                v = parse_vector_text(x.value)
+                return Column(jnp.asarray(v), None,
+                              SqlType.vector(len(v)))
+            return eval_expr(x, rel)
+
+        a = _vec_arg(e.args[0])
+        b = _vec_arg(e.args[1])
+        va, vb = a.data, b.data
+        if va.ndim == 1 and vb.ndim == 2:
+            a, b = b, a
+            va, vb = vb, va
+        va = va.astype(jnp.float32)
+        vb = vb.astype(jnp.float32)
+        if name == "l2_distance":
+            diff = va - (vb if vb.ndim == 2 else vb[None, :])
+            out = jnp.sqrt(jnp.sum(diff * diff, axis=-1)
+                           .astype(jnp.float64))
+        elif name == "cosine_distance":
+            num = jnp.sum(va * (vb if vb.ndim == 2 else vb[None, :]),
+                          axis=-1)
+            na = jnp.sqrt(jnp.sum(va * va, axis=-1))
+            nb = jnp.sqrt(jnp.sum(vb * vb, axis=-1))
+            out = (1.0 - num / jnp.maximum(na * nb, 1e-12)) \
+                .astype(jnp.float64)
+        else:
+            out = jnp.sum(va * (vb if vb.ndim == 2 else vb[None, :]),
+                          axis=-1).astype(jnp.float64)
+            if name == "negative_inner_product":
+                out = -out
+        return Column(out, _merge_valid(a, b), SqlType.double())
     if name in ("extract_year", "year", "extract_month", "month",
                 "extract_day", "day", "quarter", "dayofyear", "dayofweek",
                 "weekday"):
@@ -789,6 +866,147 @@ def _eval_func(e: ir.FuncCall, rel: Relation, n: int) -> Column:
         return Column(data=data, valid=valid, dtype=rt, sdict=sdict)
     if name in ("substring", "substr", "upper", "lower"):
         return _dict_string_func(name, e, rel)
+    if name in ("lcase", "ucase"):
+        return _dict_transform(e.args[0], rel,
+                               str.lower if name == "lcase" else str.upper)
+    if name == "if":
+        from oceanbase_tpu.expr.compile import eval_predicate as _ep
+
+        t = _ep(e.args[0], rel)
+        a = eval_expr(e.args[1], rel)
+        b = eval_expr(e.args[2], rel)
+        (a, b), rt, sdict = _unify_branches([a, b])
+        data = jnp.where(t, a.data, b.data)
+        valid = jnp.where(t, a.valid_or_true(), b.valid_or_true())
+        return Column(data, valid, rt, sdict)
+    if name == "isnull":
+        c = eval_expr(e.args[0], rel)
+        v = c.valid
+        data = jnp.zeros(n, jnp.bool_) if v is None else ~v
+        return Column(data, None, SqlType.bool_())
+    if name in ("atan", "asin", "acos", "sinh", "cosh", "tanh", "cot",
+                "degrees", "radians"):
+        c = eval_expr(e.args[0], rel)
+        x = c.data.astype(jnp.float64)
+        out = {"atan": jnp.arctan, "asin": jnp.arcsin,
+               "acos": jnp.arccos, "sinh": jnp.sinh, "cosh": jnp.cosh,
+               "tanh": jnp.tanh,
+               "cot": lambda v: 1.0 / jnp.tan(v),
+               "degrees": jnp.degrees, "radians": jnp.radians}[name](x)
+        return Column(out, c.valid, SqlType.double())
+    if name == "atan2":
+        a = eval_expr(e.args[0], rel)
+        b = eval_expr(e.args[1], rel)
+        out = jnp.arctan2(a.data.astype(jnp.float64),
+                          b.data.astype(jnp.float64))
+        return Column(out, _merge_valid(a, b), SqlType.double())
+    if name == "pi":
+        return Column(jnp.full(n, np.pi, jnp.float64), None,
+                      SqlType.double())
+    if name == "log":
+        # log(x) = ln; log(base, x) = ln(x)/ln(base) (MySQL)
+        if len(e.args) == 1:
+            c = eval_expr(e.args[0], rel)
+            return Column(jnp.log(c.data.astype(jnp.float64)), c.valid,
+                          SqlType.double())
+        b = eval_expr(e.args[0], rel)
+        c = eval_expr(e.args[1], rel)
+        out = jnp.log(c.data.astype(jnp.float64)) / \
+            jnp.log(b.data.astype(jnp.float64))
+        return Column(out, _merge_valid(b, c), SqlType.double())
+    if name == "repeat" and len(e.args) == 2 and \
+            isinstance(e.args[1], ir.Literal):
+        k = int(e.args[1].value)
+        return _dict_transform(e.args[0], rel, lambda s: s * max(k, 0))
+    if name in ("lpad", "rpad"):
+        k = int(e.args[1].value)
+        pad = str(e.args[2].value) if len(e.args) > 2 else " "
+
+        def _pad(s, k=k, pad=pad, left=(name == "lpad")):
+            if len(s) >= k:
+                return s[:k]
+            fill = (pad * k)[: k - len(s)]
+            return fill + s if left else s + fill
+
+        return _dict_transform(e.args[0], rel, _pad)
+    if name in ("instr", "locate", "position"):
+        # instr(str, sub) / locate(sub, str): 1-based, 0 = not found
+        if len(e.args) > 2:
+            raise NotImplementedError(
+                f"{name} with a start position is not supported")
+        if name == "instr":
+            col_a, sub_a = e.args[0], e.args[1]
+        else:
+            col_a, sub_a = e.args[1], e.args[0]
+        sub = str(sub_a.value) if isinstance(sub_a, ir.Literal) else None
+        if sub is None:
+            raise NotImplementedError(f"{name} needs a literal needle")
+        c = eval_expr(col_a, rel)
+        assert c.sdict is not None, f"{name} requires a string column"
+        lut = jnp.asarray(
+            c.sdict.lut(lambda s: s.find(sub) + 1).astype("int64"))
+        data = jnp.take(lut, jnp.clip(c.data, 0, c.sdict.size - 1))
+        return Column(data, c.valid, SqlType.int_())
+    if name == "ascii":
+        c = eval_expr(e.args[0], rel)
+        assert c.sdict is not None, "ascii requires a string column"
+        lut = jnp.asarray(
+            c.sdict.lut(lambda s: ord(s[0]) if s else 0).astype("int64"))
+        data = jnp.take(lut, jnp.clip(c.data, 0, c.sdict.size - 1))
+        return Column(data, c.valid, SqlType.int_())
+    if name == "substring_index" and isinstance(e.args[1], ir.Literal) \
+            and isinstance(e.args[2], ir.Literal):
+        delim = str(e.args[1].value)
+        cnt = int(e.args[2].value)
+
+        def _si(s, d=delim, k=cnt):
+            parts = s.split(d)
+            return d.join(parts[:k]) if k >= 0 else d.join(parts[k:])
+
+        return _dict_transform(e.args[0], rel, _si)
+    if name == "concat_ws":
+        sep = str(e.args[0].value) if isinstance(e.args[0], ir.Literal) \
+            else None
+        if sep is None:
+            raise NotImplementedError("concat_ws needs a literal sep")
+        if len(e.args) < 2:
+            raise NotImplementedError("concat_ws needs value arguments")
+        out = e.args[1]
+        for a in e.args[2:]:
+            out = ir.FuncCall("concat", [out, ir.Literal(sep), a])
+        return eval_expr(out, rel)
+    if name in ("md5", "sha1", "hex"):
+        import hashlib as _hl
+
+        fns = {"md5": lambda s: _hl.md5(s.encode()).hexdigest(),
+               "sha1": lambda s: _hl.sha1(s.encode()).hexdigest(),
+               "hex": lambda s: s.encode().hex().upper()}
+        return _dict_transform(e.args[0], rel, fns[name])
+    if name in ("dayname", "monthname"):
+        c = eval_expr(e.args[0], rel)
+        if name == "dayname":
+            names = np.array(["Monday", "Tuesday", "Wednesday",
+                              "Thursday", "Friday", "Saturday",
+                              "Sunday"], dtype=object)
+            codes = jnp.remainder(c.data.astype(jnp.int64) + 3, 7)
+        else:
+            names = np.array(["January", "February", "March", "April",
+                              "May", "June", "July", "August",
+                              "September", "October", "November",
+                              "December"], dtype=object)
+            _y, m, _d = civil_from_days(c.data)
+            codes = (m - 1).astype(jnp.int64)
+        # StringDict values must be sorted (searchsorted code lookups)
+        order = np.argsort(names.astype(str))
+        remap = jnp.asarray(np.argsort(order).astype(np.int32))
+        return Column(jnp.take(remap, codes).astype(jnp.int32), c.valid,
+                      SqlType.string(), StringDict(names[order]))
+    if name == "last_day":
+        c = eval_expr(e.args[0], rel)
+        y, m, d = civil_from_days(c.data)
+        out = days_from_civil(y, m, _days_in_month(y, m)) \
+            .astype(jnp.int32)
+        return Column(out, c.valid, c.dtype)
     raise NotImplementedError(f"function {name}")
 
 

@@ -32,7 +32,8 @@ from oceanbase_tpu.palf.netcluster import NetPalf
 from oceanbase_tpu.share.location import LocationCache
 
 _DDL_KINDS = {"create_table", "drop_table", "truncate", "alter_add",
-              "alter_drop", "create_index", "drop_index"}
+              "alter_drop", "create_index", "drop_index", "aux_index",
+              "drop_aux_index"}
 _WRITE_PREFIXES = ("insert", "update", "delete", "replace", "create",
                    "drop", "alter", "truncate", "load", "begin",
                    "commit", "rollback")

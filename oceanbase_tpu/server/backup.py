@@ -1,0 +1,195 @@
+"""Backup service: full + incremental physical backup, WAL archiving,
+point-in-time restore.
+
+Reference analog: data backup/restore (src/storage/backup,
+src/rootserver/backup) + the log archive service
+(src/logservice/archiveservice) feeding PITR
+(src/storage/restore).  Model:
+
+- FULL backup     = checkpoint + copy of the data tree + manifest
+- INCREMENTAL     = copy of files NEW since the base backup's manifest
+  (segment files are immutable once written, so name+size identity is
+  sound; manifests/slog/config/WAL always re-copy — they're tiny or
+  append-only)
+- WAL archive     = copy of the append-only replica logs; re-archiving
+  appends only the suffix (≙ archive progress per log stream)
+- PITR            = restore chain -> rewrite the WAL keeping commit
+  records with version <= the target timestamp (uncommitted/later txs
+  never replay) -> boot
+
+Restore = `Database(restored_root)` — recovery IS the restore path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import struct
+import time
+
+MANIFEST = "BACKUP_MANIFEST.json"
+
+
+def _walk(root: str) -> dict[str, int]:
+    out = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for f in files:
+            p = os.path.join(dirpath, f)
+            out[os.path.relpath(p, root)] = os.path.getsize(p)
+    return out
+
+
+def full_backup(db, dest: str) -> str:
+    """Checkpoint + full copy; returns the backup dir."""
+    if db.root is None:
+        raise ValueError("in-memory database cannot be backed up")
+    db.checkpoint()
+    os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+    shutil.copytree(db.root, dest, dirs_exist_ok=False)
+    files = _walk(dest)
+    files.pop(MANIFEST, None)
+    with open(os.path.join(dest, MANIFEST), "w") as fh:
+        json.dump({"kind": "full", "base": None, "ts": time.time(),
+                   "files": files}, fh)
+    return dest
+
+
+def incremental_backup(db, dest: str, base: str) -> str:
+    """Copy only files new/changed since the ``base`` backup.
+
+    Segment files are write-once (compaction writes NEW ids), so a file
+    present in the base with the same size is skipped; everything else
+    (manifest.json, slog, config, WAL logs, meta) re-copies."""
+    if db.root is None:
+        raise ValueError("in-memory database cannot be backed up")
+    with open(os.path.join(base, MANIFEST)) as fh:
+        base_m = json.load(fh)
+    db.checkpoint()
+    os.makedirs(dest, exist_ok=False)
+    copied, skipped = {}, 0
+    for rel, size in _walk(db.root).items():
+        if rel == MANIFEST:
+            continue
+        src = os.path.join(db.root, rel)
+        immutable = "segments" + os.sep in rel or rel.endswith(".seg")
+        if immutable and base_m["files"].get(rel) == size:
+            skipped += 1
+            continue
+        dst = os.path.join(dest, rel)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.copy2(src, dst)
+        copied[rel] = size
+    with open(os.path.join(dest, MANIFEST), "w") as fh:
+        json.dump({"kind": "incremental", "base": os.path.abspath(base),
+                   "ts": time.time(), "files": copied,
+                   "skipped": skipped}, fh)
+    return dest
+
+
+def archive_wal(db, dest: str):
+    """Append-only WAL archiving: copies each replica log's NEW suffix
+    (byte offset recorded per file — ≙ archive progress points)."""
+    os.makedirs(dest, exist_ok=True)
+    state_p = os.path.join(dest, "ARCHIVE_STATE.json")
+    state = {}
+    if os.path.exists(state_p):
+        with open(state_p) as fh:
+            state = json.load(fh)
+    for dirpath, _dirs, files in os.walk(db.root):
+        for f in files:
+            if not f.endswith(".log"):
+                continue
+            src = os.path.join(dirpath, f)
+            rel = os.path.relpath(src, db.root)
+            dst = os.path.join(dest, rel)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            start = state.get(rel, 0)
+            size = os.path.getsize(src)
+            if size > start:
+                with open(src, "rb") as s, open(dst, "ab") as d:
+                    s.seek(start)
+                    shutil.copyfileobj(s, d)
+                state[rel] = size
+    with open(state_p, "w") as fh:
+        json.dump(state, fh)
+    return dest
+
+
+def restore_chain(backup: str, target: str) -> str:
+    """Materialize a backup (full or incremental chain) at ``target``."""
+    chain = []
+    cur = backup
+    while cur is not None:
+        with open(os.path.join(cur, MANIFEST)) as fh:
+            m = json.load(fh)
+        chain.append(cur)
+        cur = m["base"]
+    base = chain[-1]
+    shutil.copytree(base, target, dirs_exist_ok=False)
+    for inc in reversed(chain[:-1]):
+        for dirpath, _dirs, files in os.walk(inc):
+            for f in files:
+                if f == MANIFEST:
+                    continue
+                src = os.path.join(dirpath, f)
+                rel = os.path.relpath(src, inc)
+                dst = os.path.join(target, rel)
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                shutil.copy2(src, dst)
+    os.remove(os.path.join(target, MANIFEST))
+    return target
+
+
+def overlay_archive(archive: str, target: str):
+    """Lay archived WAL over a restored tree (archived logs are always
+    at least as long as the backup's copies)."""
+    for dirpath, _dirs, files in os.walk(archive):
+        for f in files:
+            if f == "ARCHIVE_STATE.json":
+                continue
+            src = os.path.join(dirpath, f)
+            rel = os.path.relpath(src, archive)
+            dst = os.path.join(target, rel)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            shutil.copy2(src, dst)
+
+
+def pitr_cut(target: str, until_version: int):
+    """Rewrite every WAL file under ``target`` dropping COMMIT records
+    with version > until_version: transactions past the cut never
+    replay, giving a consistent snapshot at the target point
+    (≙ restoring to a timestamp, src/storage/restore)."""
+    from oceanbase_tpu.palf.log import _HDR, _MAGIC, LogEntry
+
+    for dirpath, _dirs, files in os.walk(target):
+        for f in files:
+            if not (f.startswith("replica_") and f.endswith(".log")):
+                continue
+            path = os.path.join(dirpath, f)
+            with open(path, "rb") as fh:
+                buf = fh.read()
+            if not buf.startswith(_MAGIC):
+                continue
+            off = len(_MAGIC)
+            kept: list[LogEntry] = []
+            while off + _HDR.size <= len(buf):
+                term, lsn, plen, _crc = _HDR.unpack_from(buf, off)
+                off += _HDR.size
+                payload = buf[off:off + plen]
+                off += plen
+                try:
+                    rec = json.loads(payload.decode())
+                except Exception:
+                    rec = {}
+                if rec.get("op") == "commit" and \
+                        rec.get("version", 0) > until_version:
+                    continue  # drop: this tx commits after the cut
+                kept.append(LogEntry(term, lsn, payload))
+            # re-number LSNs densely (accept() requires a gapless log)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(_MAGIC)
+                for i, e in enumerate(kept, 1):
+                    fh.write(LogEntry(e.term, i, e.payload).encode())
+            os.replace(tmp, path)

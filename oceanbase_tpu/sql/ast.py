@@ -144,6 +144,9 @@ class CreateIndexStmt:
     columns: list            # list[str]
     unique: bool = False
     if_not_exists: bool = False
+    # "normal" | "vector" | "fulltext" (≙ INDEX_TYPE_* in ob_table_schema)
+    kind: str = "normal"
+    options: dict = field(default_factory=dict)  # e.g. {"metric": "l2"}
 
 
 @dataclass
@@ -277,3 +280,11 @@ class SequenceStmt:
     start: int = 1
     increment: int = 1
     cache: int = 1000
+
+@dataclass
+class SavepointStmt:
+    """SAVEPOINT / ROLLBACK TO SAVEPOINT / RELEASE SAVEPOINT
+    (≙ savepoint handling in the tx service, ob_trans_service savepoints)."""
+
+    op: str      # create | rollback | release
+    name: str = ""

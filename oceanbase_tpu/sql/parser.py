@@ -194,6 +194,19 @@ class Parser:
             self.next()
             self.accept_kw("table")
             return ast.AnalyzeStmt(self.expect_ident())
+        if self.peek().kind == "ident" and self.peek().value == "savepoint":
+            self.next()
+            return ast.SavepointStmt("create", self.expect_ident())
+        if self.peek().kind == "ident" and self.peek().value == "release":
+            self.next()
+            if not self._accept_word("savepoint"):
+                raise ParseError("expected SAVEPOINT after RELEASE")
+            return ast.SavepointStmt("release", self.expect_ident())
+        if self.at_kw("rollback") and self.peek(1).value == "to":
+            self.next()
+            self.next()
+            self._accept_word("savepoint")
+            return ast.SavepointStmt("rollback", self.expect_ident())
         if self.at_kw("begin", "commit", "rollback"):
             return ast.TxStmt(self.next().value)
         t = self.peek()
@@ -696,6 +709,18 @@ class Parser:
             while self.accept_op(","):
                 args.append(self.parse_expr())
         self.expect_op(")")
+        if name == "match" and self._accept_word("against"):
+            # MATCH(col) AGAINST('terms' [IN NATURAL LANGUAGE MODE |
+            # IN BOOLEAN MODE]) — modes parse and collapse to the same
+            # term-containment scoring
+            self.expect_op("(")
+            terms = self._string_lit()
+            if self.accept_kw("in"):
+                while not self.at_op(")"):
+                    self.next()
+            self.expect_op(")")
+            return ir.FuncCall("match_against",
+                               [args[0], ir.Literal(terms)])
         if self.at_kw("over"):
             return self.parse_over(name, args)
         if name in ("count", "sum", "avg", "min", "max"):
@@ -839,6 +864,11 @@ class Parser:
             return SqlType.datetime()
         if name in ("boolean", "bool"):
             return SqlType.bool_()
+        if name == "vector":
+            self.expect_op("(")
+            d = self._int_token()
+            self.expect_op(")")
+            return SqlType.vector(d)
         raise ParseError(f"unknown type {name!r} at {t.pos}")
 
     def _literal_value(self):
@@ -988,8 +1018,9 @@ class Parser:
         self.expect_op(")")
         return out
 
-    def parse_create_index(self, unique: bool):
-        """CREATE [UNIQUE] INDEX [IF NOT EXISTS] name ON table (cols)."""
+    def parse_create_index(self, unique: bool, kind: str = "normal"):
+        """CREATE [UNIQUE|VECTOR|FULLTEXT] INDEX [IF NOT EXISTS] name
+        ON table (cols) [WITH (k = v, ...)]."""
         self.expect_kw("index")
         if_not_exists = False
         if self.accept_kw("if"):
@@ -1000,19 +1031,34 @@ class Parser:
         self.expect_kw("on")
         table = self.expect_ident()
         cols = self._parse_paren_idents()
+        options = {}
+        if self._accept_word("with"):
+            self.expect_op("(")
+            while True:
+                k = self.expect_ident()
+                self.expect_op("=")
+                options[k] = self._literal_value()
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
         return ast.CreateIndexStmt(name, table, cols, unique,
-                                   if_not_exists)
+                                   if_not_exists, kind=kind,
+                                   options=options)
 
     def parse_create(self):
         self.expect_kw("create")
         unique = False
+        kind = "normal"
         if self.peek().kind == "ident" and self.peek().value == "unique":
             self.next()
             unique = True
+        elif self.peek().kind == "ident" and \
+                self.peek().value in ("vector", "fulltext"):
+            kind = self.next().value
         if self.at_kw("index"):
-            return self.parse_create_index(unique)
-        if unique:
-            raise ParseError("expected INDEX after CREATE UNIQUE")
+            return self.parse_create_index(unique, kind)
+        if unique or kind != "normal":
+            raise ParseError("expected INDEX")
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):

@@ -14,6 +14,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 from oceanbase_tpu.catalog import Catalog, ColumnDef, TableDef
@@ -241,6 +242,8 @@ class Session:
             return self._analyze(stmt)
         if isinstance(stmt, ast.TxStmt):
             return self._tx_control(stmt.op)
+        if isinstance(stmt, ast.SavepointStmt):
+            return self._savepoint(stmt)
         if isinstance(stmt, ast.SetVarStmt):
             return self._set_var(stmt)
         if isinstance(stmt, ast.AlterSystemStmt):
@@ -691,6 +694,7 @@ class Session:
         tables = {t: self._table_snapshot(t)
                   for t in referenced_tables(plan)
                   if self.catalog.has_table(t)}
+        self._try_ann_prefilter(plan, tables)
         self._last_access_paths = self._index_prefilter(plan, tables)
         monitor = None
         if self.db is not None and \
@@ -730,6 +734,134 @@ class Session:
                 plan.fingerprint()[:64] if hasattr(plan, "fingerprint")
                 else "", monitor, time.time() - t0)
         return self._materialize(rel, outputs)
+
+    # -- ANN top-k access path (vector index) ---------------------------
+    _ANN_FETCH_FACTOR = 4
+
+    def _try_ann_prefilter(self, plan, tables):
+        """ORDER BY <distance>(vcol, '[...]') [ASC] LIMIT k over a
+        single vector-indexed scan: replace the scanned relation with
+        the index's top candidates, so the unchanged plan re-sorts a
+        handful of rows instead of the whole table (≙ the vector-index
+        access path lowering ORDER BY distance APPROXIMATE LIMIT k onto
+        the ANN index; exact for small tables, IVF recall above).
+
+        The substitution is APPROXIMATE by design for IVF (matching the
+        reference's approximate vector search semantics); small tables
+        search exactly, making the result identical to the full sort."""
+        from oceanbase_tpu.exec import plan as pp
+        from oceanbase_tpu.expr import ir as _ir
+
+        if not isinstance(plan, pp.Limit):
+            return
+        node = plan.child
+        k = plan.k + (plan.offset or 0)
+        if not isinstance(node, pp.Sort) or len(node.keys) != 1 or \
+                not (node.ascending[0] if node.ascending else True):
+            return
+        key = node.keys[0]
+        if not isinstance(key, _ir.ColumnRef):
+            return
+        # resolve the sort column through Project/Compact to the scan
+        expr, cur = None, node.child
+        while True:
+            if isinstance(cur, pp.Project):
+                if expr is None:
+                    expr = cur.outputs.get(key.name)
+                    if expr is None:
+                        return
+                else:
+                    # nested projects would need substitution; keep the
+                    # simple shape
+                    return
+                cur = cur.child
+            elif isinstance(cur, pp.Compact):
+                cur = cur.child
+            else:
+                break
+        if not isinstance(cur, pp.TableScan) or expr is None:
+            return
+        if not isinstance(expr, _ir.FuncCall) or expr.name.lower() not in \
+                ("l2_distance", "cosine_distance"):
+            return
+        args = expr.args
+        colref = next((a for a in args if isinstance(a, _ir.ColumnRef)),
+                      None)
+        lit = next((a for a in args if isinstance(a, _ir.Literal)
+                    and isinstance(a.value, str)), None)
+        if colref is None or lit is None:
+            return
+        inv = {cid: base for base, cid in (cur.rename or {}).items()}
+        base_col = inv.get(colref.name, colref.name)
+        td = self.catalog.table_def(cur.table)
+        metric = {"l2_distance": "l2",
+                  "cosine_distance": "cosine"}[expr.name.lower()]
+        vix = next((v for v in td.aux_indexes.values()
+                    if v["kind"] == "vector" and v["column"] == base_col
+                    and v["metric"] == metric), None)
+        if vix is None:
+            return
+        rel = tables.get(cur.table)
+        if rel is None or rel.capacity <= max(k * self._ANN_FETCH_FACTOR,
+                                              64):
+            return
+        from oceanbase_tpu.expr.compile import parse_vector_text
+
+        q = parse_vector_text(lit.value)[None, :]
+        idx = self._ann_runtime(cur.table, base_col, metric, rel)
+        fetch = min(max(k * self._ANN_FETCH_FACTOR, 64), rel.capacity)
+        if idx is None:
+            return
+        import numpy as _np
+
+        if hasattr(idx, "search"):
+            _s, ids = idx.search(q, fetch)
+        else:
+            from oceanbase_tpu.share.vector_index import exact_search
+
+            _s, ids = exact_search(q, idx, fetch, metric=metric)
+        rows = _np.asarray(ids)[0]
+        rows = rows[rows >= 0]
+        if len(rows) == 0:
+            return
+        take = jnp.asarray(_np.sort(rows))
+        mask = None
+        if rel.mask is not None:
+            mask = jnp.take(rel.mask, take)
+        tables[cur.table] = rel.gather(take, mask)
+
+    def _ann_runtime(self, table: str, col: str, metric: str, rel):
+        """Lazily (re)built ANN structure for (table, col): IVF-Flat
+        above IVF_MIN_ROWS, the raw vector matrix (exact matmul search)
+        below.  Keyed by data_version so DML invalidates."""
+        import numpy as _np
+
+        from oceanbase_tpu.share.vector_index import IvfFlatIndex
+
+        cache = getattr(self.catalog, "_ann_cache", None)
+        if cache is None:
+            cache = self.catalog._ann_cache = {}
+        ts = self._engine.tables.get(table) if self.db is not None else None
+        if ts is not None:
+            ver = ts.tablet.data_version
+        else:
+            # catalog-only: set_data replaces the Relation object, so its
+            # identity is the data version
+            ver = id(rel)
+        key = (table, col, metric)
+        hit = cache.get(key)
+        if hit is not None and hit[0] == ver:
+            return hit[1]
+        colv = rel.columns.get(col)
+        if colv is None or _np.asarray(colv.data).ndim != 2:
+            return None
+        vecs = _np.asarray(colv.data)
+        if rel.mask is not None and not bool(_np.asarray(rel.mask).all()):
+            return None  # dead rows would need an id remap; skip
+        idx = IvfFlatIndex(vecs, metric=metric) if len(vecs) >= 100_000 \
+            else jnp.asarray(vecs)
+        cache[key] = (ver, idx)
+        return idx
 
     def _index_prefilter(self, plan, tables) -> dict:
         """Candidate-superset access paths (sql/access_path.py): replace
@@ -1167,10 +1299,37 @@ class Session:
         """CREATE [UNIQUE] INDEX: engine-side index table + backfill
         (≙ ObDDLService index build); the plan cache invalidates via the
         schema-version bump so access paths re-resolve."""
+        td = self.catalog.table_def(stmt.table)
+        if stmt.kind in ("vector", "fulltext"):
+            # metadata only; the IVF buckets / posting lists build
+            # lazily per data_version (≙ vector/FTS index DDL,
+            # src/share/vector_index + src/storage/fts)
+            if stmt.name in td.aux_indexes:
+                if stmt.if_not_exists:
+                    return _ok()
+                raise ValueError(f"index {stmt.name} exists")
+            if len(stmt.columns) != 1:
+                raise ValueError(f"{stmt.kind} index takes one column")
+            col = td.column(stmt.columns[0])  # existence check
+            if stmt.kind == "vector" and col.dtype.kind != TypeKind.VECTOR:
+                raise ValueError("vector index needs a VECTOR column")
+            if stmt.kind == "fulltext" and not col.dtype.is_string:
+                raise ValueError("fulltext index needs a string column")
+            spec = {"kind": stmt.kind, "column": stmt.columns[0],
+                    "metric": str(stmt.options.get("metric", "l2")),
+                    "options": dict(stmt.options)}
+            td.aux_indexes[stmt.name] = spec
+            if self.db is not None and \
+                    stmt.table in self._engine.tables:
+                # persist through the slog (+ the multi-node DDL stream)
+                self._engine._log_meta({"op": "aux_index",
+                                        "table": stmt.table,
+                                        "name": stmt.name, "spec": spec})
+            self.catalog.schema_version += 1
+            return _ok()
         if self.db is None:
             raise NotImplementedError(
                 "CREATE INDEX needs the storage engine")
-        td = self.catalog.table_def(stmt.table)
         if any(ix.name == stmt.name for ix in td.indexes):
             if stmt.if_not_exists:
                 return _ok()
@@ -1219,6 +1378,19 @@ class Session:
         return drain
 
     def _drop_index(self, stmt: ast.DropIndexStmt) -> Result:
+        td = self.catalog.table_def(stmt.table)
+        if stmt.name in td.aux_indexes:
+            td.aux_indexes.pop(stmt.name, None)
+            cache = getattr(self.catalog, "_ann_cache", None)
+            if cache is not None:
+                for k in [k for k in cache if k[0] == stmt.table]:
+                    cache.pop(k, None)
+            if self.db is not None and stmt.table in self._engine.tables:
+                self._engine._log_meta({"op": "drop_aux_index",
+                                        "table": stmt.table,
+                                        "name": stmt.name})
+            self.catalog.schema_version += 1
+            return _ok()
         if self.db is None:
             raise NotImplementedError("DROP INDEX needs the storage engine")
         try:
@@ -1233,6 +1405,45 @@ class Session:
     # ------------------------------------------------------------------
     # transactional DML (storage/tx plane)
     # ------------------------------------------------------------------
+    def _savepoint(self, stmt: ast.SavepointStmt) -> Result:
+        """SAVEPOINT name / ROLLBACK TO name / RELEASE name: a savepoint
+        records the tx's statement counter + per-table write counts;
+        rollback-to aborts every write with a later statement seq
+        (statement-granular undo, ≙ savepoint rollback over
+        ObPartTransCtx's stmt-scoped callbacks)."""
+        if self._tx is None:
+            raise RuntimeError("no active transaction for SAVEPOINT")
+        tx = self._tx
+        if not hasattr(tx, "savepoints"):
+            tx.savepoints = {}
+        if stmt.op == "create":
+            tx.savepoints[stmt.name] = (
+                tx.stmt_seq,
+                {t: len(p.keys) for t, p in tx.participants.items()})
+            return _ok()
+        sp = tx.savepoints.get(stmt.name)
+        if sp is None:
+            raise KeyError(f"savepoint {stmt.name} does not exist")
+        if stmt.op == "release":
+            del tx.savepoints[stmt.name]
+            return _ok()
+        # rollback to: undo everything written after the savepoint
+        sp_seq, counts = sp
+        stmt_writes = {}
+        for t, p in tx.participants.items():
+            new = p.keys[counts.get(t, 0):]
+            if new:
+                stmt_writes[t] = new
+        self._txsvc.rollback_statement(tx, sp_seq + 1, stmt_writes)
+        for t, p in tx.participants.items():
+            del p.keys[counts.get(t, 0):]
+        # savepoints created after this one are destroyed (MySQL)
+        tx.savepoints = {n: v for n, v in tx.savepoints.items()
+                         if v[0] <= sp_seq}
+        for t in stmt_writes:
+            self.catalog.invalidate(t)
+        return _ok()
+
     def _run_in_tx(self, fn, tx_hint=None):
         """Run fn(tx) in the active explicit transaction (with
         statement-level rollback on failure) or an autocommit one
@@ -1820,6 +2031,15 @@ def _coerce_value(v, t, target: SqlType):
         return date_to_days(v)
     if target.kind == TypeKind.BOOL:
         return bool(v)
+    if target.kind == TypeKind.VECTOR and isinstance(v, str):
+        from oceanbase_tpu.expr.compile import parse_vector_text
+
+        vec = parse_vector_text(v)
+        if len(vec) != target.precision:
+            raise ValueError(
+                f"vector literal has dim {len(vec)}, column wants "
+                f"{target.precision}")
+        return [float(x) for x in vec]
     return v
 
 

@@ -91,7 +91,8 @@ def encode_column(arr: np.ndarray, valid: np.ndarray | None) -> EncodedColumn:
     """Pick an encoding by measured size (≙ encoding selector cost rule)."""
     n = len(arr)
     zone = _zone(arr, valid)
-    if n == 0 or arr.dtype == object:
+    if n == 0 or arr.dtype == object or arr.ndim > 1:
+        # object strings and [n,d] vector embeddings store plain
         return EncodedColumn("plain", {"data": arr}, valid, zone, n)
 
     candidates: list[tuple[int, str, dict]] = [

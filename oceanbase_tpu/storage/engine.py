@@ -96,6 +96,10 @@ class StorageEngine:
                     "auto_increment": list(ts.tdef.auto_increment_cols),
                     "indexes": [[ix.name, list(ix.columns), ix.unique]
                                 for ix in ts.tdef.indexes],
+                    "aux_indexes": {n: {k: v for k, v in spec.items()
+                                        if k != "runtime"}
+                                    for n, spec in
+                                    ts.tdef.aux_indexes.items()},
                     "segments": [[s.segment_id, s.level, part]
                                  for s, part in
                                  ts.tablet.segment_locations()],
@@ -133,6 +137,7 @@ class StorageEngine:
                     ts.tdef.indexes.append(IndexDef(
                         iname, name, list(icols), iuniq,
                         self.index_storage_name(name, iname)))
+                ts.tdef.aux_indexes.update(t.get("aux_indexes", {}))
                 for entry in t["segments"]:
                     seg_id, level = entry[0], entry[1]
                     part_idx = entry[2] if len(entry) > 2 else None
@@ -194,6 +199,14 @@ class StorageEngine:
             if ts is not None:
                 ts.tdef.indexes = [ix for ix in ts.tdef.indexes
                                    if ix.name != op["name"]]
+        elif kind == "aux_index":
+            ts = self.tables.get(op["table"])
+            if ts is not None:
+                ts.tdef.aux_indexes[op["name"]] = op["spec"]
+        elif kind == "drop_aux_index":
+            ts = self.tables.get(op["table"])
+            if ts is not None:
+                ts.tdef.aux_indexes.pop(op["name"], None)
         elif kind == "add_segment":
             ts = self.tables.get(op["table"])
             if ts is not None:
@@ -785,10 +798,19 @@ class StorageCatalog(Catalog):
                     store_valids[c] = valids[c]
             self.engine.bulk_load(name, store_arrays, store_valids or None)
             self._defs[name] = self.engine.tables[name].tdef
+            from oceanbase_tpu.catalog import sampled_ndv
+            from oceanbase_tpu.datatypes import TypeKind as _TK
+
             for c in cols:
-                self._defs[name].ndv[c.name] = rel.columns[c.name].sdict.size \
-                    if rel.columns[c.name].sdict is not None else \
-                    max(1, min(rel.capacity, int(rel.capacity ** 0.8)))
+                col = rel.columns[c.name]
+                if col.sdict is not None:
+                    nd = col.sdict.size
+                elif col.dtype.kind == _TK.VECTOR:
+                    nd = rel.capacity
+                else:
+                    nd = sampled_ndv(np.asarray(arrays[c.name]),
+                                     rel.capacity)
+                self._defs[name].ndv[c.name] = nd
             self.schema_version += 1
             self._cache.pop(name, None)
 

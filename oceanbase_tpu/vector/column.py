@@ -206,6 +206,29 @@ def from_numpy(
     for name, arr in arrays.items():
         arr = np.asarray(arr)
         sdict = None
+        want = types.get(name) if types else None
+        vec_t = want is not None and want.kind == TypeKind.VECTOR
+        if arr.dtype == object and len(arr) and \
+                isinstance(arr.reshape(-1)[0], (list, np.ndarray)) and \
+                arr.ndim == 1:
+            # object array of per-row embeddings -> [n, d] float32
+            arr = np.stack([np.asarray(v, dtype=np.float32)
+                            for v in arr])
+            vec_t = True
+        if arr.ndim == 2 or vec_t:
+            if arr.ndim == 1:
+                # a VECTOR-typed column seeded from a flat placeholder
+                # (empty-table seeds): shape it [n, dim]
+                dim = want.precision if want is not None else 0
+                arr = np.zeros((len(arr), dim), dtype=np.float32)
+            data = arr.astype(np.float32)
+            dtype = SqlType.vector(data.shape[1])
+            valid = None
+            if valids and name in valids and valids[name] is not None:
+                valid = jnp.asarray(valids[name].astype(np.bool_))
+            cols[name] = Column(jax.device_put(jnp.asarray(data), device),
+                                valid, dtype)
+            continue
         if arr.dtype.kind in ("U", "S", "O"):
             codes, sdict = StringDict.encode(arr)
             data = codes
@@ -248,6 +271,14 @@ def to_numpy(rel: Relation, limit: int | None = None) -> dict[str, np.ndarray]:
         idx = idx[:limit]
     for name, col in rel.columns.items():
         data = np.asarray(col.data)[idx]
+        if col.dtype.kind == TypeKind.VECTOR:
+            # embeddings come back as an object array of float32 rows
+            out[name] = np.array([data[i] for i in range(len(data))],
+                                 dtype=object)
+            if col.valid is not None:
+                out.setdefault("__valid__" + name,
+                               np.asarray(col.valid)[idx])
+            continue
         if col.sdict is not None:
             codes = np.clip(data, 0, col.sdict.size - 1)
             vals = col.sdict.values[codes]

@@ -1,0 +1,99 @@
+"""Incremental backup, WAL archiving, point-in-time restore
+(VERDICT r3 missing #10).
+
+≙ src/storage/backup (data backup), src/logservice/archiveservice
+(log archive), src/storage/restore (PITR).
+"""
+
+import os
+
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.server.backup import (
+    archive_wal,
+    full_backup,
+    incremental_backup,
+    overlay_archive,
+    pitr_cut,
+    restore_chain,
+)
+
+
+def test_incremental_backup_restore(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values " + ", ".join(
+        f"({i}, {i * 2})" for i in range(3000)))
+    full = full_backup(db, str(tmp_path / "b0"))
+    # more data after the full backup
+    s.execute("insert into t values " + ", ".join(
+        f"({i}, {i * 2})" for i in range(3000, 5000)))
+    s.execute("create table u (k int primary key, s varchar(8))")
+    s.execute("insert into u values (1, 'x'), (2, 'y')")
+    inc = incremental_backup(db, str(tmp_path / "b1"), full)
+    db.close()
+
+    # the incremental skipped unchanged segment files
+    import json
+
+    with open(os.path.join(inc, "BACKUP_MANIFEST.json")) as fh:
+        m = json.load(fh)
+    assert m["kind"] == "incremental" and m["skipped"] > 0
+
+    target = str(tmp_path / "restored")
+    restore_chain(inc, target)
+    db2 = Database(target)
+    s2 = db2.session()
+    assert s2.execute("select count(*), sum(v) from t").rows()[0] == \
+        (5000, sum(i * 2 for i in range(5000)))
+    assert s2.execute("select count(*) from u").rows()[0][0] == 2
+    db2.close()
+
+
+def test_wal_archive_and_pitr(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 10), (2, 20)")
+    full = full_backup(db, str(tmp_path / "base"))
+    # capture the PITR target point AFTER the next commit
+    s.execute("insert into t values (3, 30)")
+    cut_version = db.tx.gts.current()
+    # later commits that PITR must NOT restore
+    s.execute("insert into t values (4, 40)")
+    s.execute("update t set v = 999 where k = 1")
+    archive = archive_wal(db, str(tmp_path / "arch"))
+    db.close()
+
+    target = str(tmp_path / "pitr")
+    restore_chain(full, target)
+    overlay_archive(archive, target)
+    pitr_cut(target, cut_version)
+    db2 = Database(target)
+    s2 = db2.session()
+    rows = s2.execute("select k, v from t order by k").rows()
+    assert rows == [(1, 10), (2, 20), (3, 30)], rows
+    # the restored instance keeps working (new writes replicate fine)
+    s2.execute("insert into t values (5, 50)")
+    assert s2.execute("select count(*) from t").rows()[0][0] == 4
+    db2.close()
+
+
+def test_archive_is_incremental(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key)")
+    s.execute("insert into t values (1)")
+    arch = str(tmp_path / "arch")
+    archive_wal(db, arch)
+    import json
+
+    with open(os.path.join(arch, "ARCHIVE_STATE.json")) as fh:
+        st1 = json.load(fh)
+    s.execute("insert into t values (2)")
+    archive_wal(db, arch)
+    with open(os.path.join(arch, "ARCHIVE_STATE.json")) as fh:
+        st2 = json.load(fh)
+    # progress points advanced (suffix-only copy)
+    assert any(st2[k] > st1.get(k, 0) for k in st2)
+    db.close()

@@ -95,3 +95,47 @@ def test_date_functions():
                   ).rows()
     assert r[0][0] == "1995-03-15"
     assert r[2][0] == "2001-02-28"  # leap-day clamp
+
+
+def test_extended_function_batch():
+    """Round-4 function-surface widening (≙ src/sql/engine/expr breadth:
+    string pad/search, math, conditional, date-name functions)."""
+    import numpy as np
+
+    from oceanbase_tpu.sql import Session
+
+    s = Session()
+    s.catalog.load_numpy(
+        "fx", {"k": np.arange(3),
+               "s": np.array(["abc", "hello world", ""], dtype=object),
+               "d": np.array([19723, 19754, 19783], dtype=np.int64)},
+        primary_key=["k"])
+    cases = [
+        ("select lpad(s, 5, '*') from fx order by k",
+         ["**abc", "hello", "*****"]),
+        ("select repeat(s, 2) from fx order by k",
+         ["abcabc", "hello worldhello world", ""]),
+        ("select instr(s, 'l') from fx order by k", [0, 3, 0]),
+        ("select substring_index(s, ' ', 1) from fx order by k",
+         ["abc", "hello", ""]),
+        ("select if(k = 1, upper(s), s) from fx order by k",
+         ["abc", "HELLO WORLD", ""]),
+        ("select isnull(s) from fx order by k", [0, 0, 0]),
+        ("select sign(k - 1) from fx order by k", [-1, 0, 1]),
+    ]
+    for sql, exp in cases:
+        got = [r[0] for r in s.execute(sql).rows()]
+        assert got == exp, (sql, got, exp)
+    # float math
+    got = s.execute("select degrees(pi()), log(2, 8.0), "
+                    "round(atan2(1.0, 1.0), 4) from fx limit 1").rows()[0]
+    assert abs(got[0] - 180.0) < 1e-9 and abs(got[1] - 3.0) < 1e-9
+    # date names: day 19723 = 2024-01-01, a Monday
+    got = s.execute("select dayname(d), monthname(d) from fx "
+                    "order by k limit 1").rows()[0]
+    assert got == ("Monday", "January")
+    # md5 is the real digest
+    import hashlib
+
+    got = s.execute("select md5(s) from fx order by k limit 1").rows()[0][0]
+    assert got == hashlib.md5(b"abc").hexdigest()

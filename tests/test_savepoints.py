@@ -1,0 +1,72 @@
+"""SAVEPOINT / ROLLBACK TO / RELEASE inside explicit transactions.
+
+≙ savepoint rollback over statement-scoped undo
+(src/storage/tx savepoint handling).
+"""
+
+import pytest
+
+from oceanbase_tpu.server import Database
+
+
+def test_savepoint_rollback_to(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("begin")
+    s.execute("insert into t values (1, 10)")
+    s.execute("savepoint sp1")
+    s.execute("insert into t values (2, 20)")
+    s.execute("update t set v = 99 where k = 1")
+    assert s.execute("select sum(v) from t").rows()[0][0] == 119
+    s.execute("rollback to savepoint sp1")
+    # writes after sp1 are gone, the one before it remains
+    assert s.execute("select k, v from t order by k").rows() == [(1, 10)]
+    s.execute("insert into t values (3, 30)")
+    s.execute("commit")
+    assert s.execute("select k, v from t order by k").rows() == \
+        [(1, 10), (3, 30)]
+    db.close()
+
+
+def test_savepoint_release_and_nesting(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("begin")
+    s.execute("insert into t values (1, 1)")
+    s.execute("savepoint a")
+    s.execute("insert into t values (2, 2)")
+    s.execute("savepoint b")
+    s.execute("insert into t values (3, 3)")
+    s.execute("rollback to a")
+    # b was created after a -> destroyed
+    with pytest.raises(Exception):
+        s.execute("rollback to b")
+    s.execute("commit")
+    assert s.execute("select count(*) from t").rows()[0][0] == 1
+    # release removes the name
+    s.execute("begin")
+    s.execute("savepoint x")
+    s.execute("release savepoint x")
+    with pytest.raises(Exception):
+        s.execute("rollback to x")
+    s.execute("rollback")
+    db.close()
+
+
+def test_savepoint_with_secondary_index(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("create unique index iv on t (v)")
+    s.execute("begin")
+    s.execute("insert into t values (1, 100)")
+    s.execute("savepoint sp")
+    s.execute("insert into t values (2, 200)")
+    s.execute("rollback to sp")
+    # the rolled-back unique value is free again
+    s.execute("insert into t values (3, 200)")
+    s.execute("commit")
+    assert s.execute("select k from t where v = 200").rows() == [(3,)]
+    db.close()
