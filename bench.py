@@ -327,6 +327,9 @@ def main():
         "numpy_cpu_time_s": round(cpu_time, 4),
         "rows": n_rows,
         "platform": platform,
+        # baseline fairness: the numpy oracle is single-threaded; on this
+        # host that IS the CPU's best (report cores so a skeptic can see)
+        "host_nproc": os.cpu_count(),
     }
     try:
         rec["loop_iters"] = k_used

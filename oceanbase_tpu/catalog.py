@@ -109,7 +109,66 @@ class Catalog:
         # transient tables: materialized virtual (gv$/v$) relations,
         # refreshed per statement (≙ virtual table iterators)
         self._transients: dict[str, tuple] = {}
+        # external (lake) tables: name -> {"tdef", "location", "format",
+        # "delimiter", "skip", "cache": (mtime, Relation)|None}
+        # (≙ src/share/external_table — files scanned at query time)
+        self._externals: dict[str, dict] = {}
         self.schema_version = 1
+
+    # -- external tables --------------------------------------------------
+    def register_external(self, tdef: TableDef, location: str,
+                          fmt: str = "csv", delimiter: str = ",",
+                          skip_lines: int = 0,
+                          if_not_exists: bool = False):
+        with self._lock:
+            if tdef.name in self._externals:
+                if if_not_exists:
+                    return
+                raise ValueError(f"external table {tdef.name} exists")
+        if self.has_table(tdef.name):
+            # never shadow an existing base/transient table
+            raise ValueError(f"table {tdef.name} already exists")
+        with self._lock:
+            self._externals[tdef.name] = {
+                "tdef": tdef, "location": location, "format": fmt,
+                "delimiter": delimiter, "skip": skip_lines,
+                "cache": None}
+            self.schema_version += 1
+
+    def drop_external(self, name: str) -> bool:
+        with self._lock:
+            if self._externals.pop(name, None) is not None:
+                self.schema_version += 1
+                return True
+            return False
+
+    def _external_lookup(self, name: str):
+        return self._externals.get(name)
+
+    def _external_data(self, name: str) -> Relation:
+        import os as _os
+
+        from oceanbase_tpu.share.external import read_external
+
+        e = self._externals.get(name)
+        if e is None:  # dropped concurrently: the normal missing-table path
+            raise KeyError(f"unknown table {name}")
+        try:
+            mtime = _os.path.getmtime(e["location"])
+        except OSError:
+            mtime = None
+        with self._lock:
+            hit = e["cache"]
+            if hit is not None and hit[0] == mtime:
+                return hit[1]
+        arrays, valids, types = read_external(
+            e["location"], e["format"], e["tdef"], e["delimiter"],
+            e["skip"])
+        rel = from_numpy(arrays, types=types, valids=valids or None)
+        with self._lock:
+            e["cache"] = (mtime, rel)
+            e["tdef"].row_count = rel.capacity
+        return rel
 
     def register_transient(self, name: str, arrays, types=None):
         import jax.numpy as jnp
@@ -138,7 +197,7 @@ class Catalog:
     # -- DDL -------------------------------------------------------------
     def create_table(self, tdef: TableDef, if_not_exists: bool = False):
         with self._lock:
-            if tdef.name in self._defs:
+            if tdef.name in self._defs or tdef.name in self._externals:
                 if if_not_exists:
                     return
                 raise ValueError(f"table {tdef.name} already exists")
@@ -195,6 +254,9 @@ class Catalog:
             t = self._transients.get(name)
             if t is not None:
                 return t[0]
+            e = self._externals.get(name)
+            if e is not None:
+                return e["tdef"]
             if name not in self._defs:
                 raise KeyError(f"unknown table {name}")
             return self._defs[name]
@@ -204,17 +266,22 @@ class Catalog:
             t = self._transients.get(name)
             if t is not None:
                 return t[1]
+        if name in self._externals:
+            return self._external_data(name)
+        with self._lock:
             if name not in self._data:
                 raise KeyError(f"table {name} has no data")
             return self._data[name]
 
     def has_table(self, name: str) -> bool:
         with self._lock:
-            return name in self._defs or name in self._transients
+            return name in self._defs or name in self._transients or \
+                name in self._externals
 
     def tables(self) -> list[str]:
         with self._lock:
             # index storage tables are internal (reachable by name, but
             # hidden from SHOW TABLES / information_schema enumeration)
-            return sorted(n for n in self._defs
-                          if not n.startswith("__idx__"))
+            return sorted([n for n in self._defs
+                           if not n.startswith("__idx__")]
+                          + list(self._externals))

@@ -971,9 +971,15 @@ def _eval_func(e: ir.FuncCall, rel: Relation, n: int) -> Column:
             raise NotImplementedError("concat_ws needs a literal sep")
         if len(e.args) < 2:
             raise NotImplementedError("concat_ws needs value arguments")
+        # MySQL semantics: NULL values are SKIPPED (with their
+        # separator), unlike CONCAT's null propagation — fold with CASE
         out = e.args[1]
         for a in e.args[2:]:
-            out = ir.FuncCall("concat", [out, ir.Literal(sep), a])
+            out = ir.Case(whens=[
+                (ir.FuncCall("isnull", [out]), a),
+                (ir.FuncCall("isnull", [a]), out),
+            ], else_=ir.FuncCall("concat", [out, ir.Literal(sep), a]))
+        out = ir.FuncCall("coalesce", [out, ir.Literal("")])
         return eval_expr(out, rel)
     if name in ("md5", "sha1", "hex"):
         import hashlib as _hl

@@ -125,6 +125,16 @@ DEF("sql_audit_queue_size", 10000, "int",
     "ring-buffer capacity of gv$sql_audit", _pos)
 DEF("enable_defensive_check", True, "bool",
     "extra engine invariant checks (≙ _enable_defensive_check)")
+DEF("kv_cache_limit_bytes", 2 << 30, "cap",
+    "device-relation (block) cache budget per tenant "
+    "(≙ ObKVGlobalCache memory limit)", _pos)
+DEF("enable_dbms_jobs", False, "bool",
+    "start the DBMS job scheduler thread at boot (stats auto-gather, "
+    "auto compaction — ≙ dbms_scheduler maintenance windows)")
+DEF("stats_gather_interval_s", 600.0, "float",
+    "auto stats gather period", _pos)
+DEF("auto_compact_interval_s", 3600.0, "float",
+    "auto major-compaction period", _pos)
 DEF("lock_wait_timeout_s", 5.0, "float",
     "implicit DML table-lock wait budget (≙ lock_wait_timeout)", _pos)
 

@@ -54,6 +54,18 @@ class Database:
         self.virtual_tables = VirtualTables(self)
         if start_ash and self.config["enable_ash"]:
             self.ash.start()
+        # DBMS job scheduler (≙ dbms_job/dbms_scheduler); built-ins
+        # register at boot, the thread starts on demand or when enabled
+        from oceanbase_tpu.server.jobs import JobScheduler
+
+        self.jobs = JobScheduler(self)
+        self.jobs.register_builtins(
+            stats_interval_s=float(
+                self.config["stats_gather_interval_s"]),
+            compact_interval_s=float(
+                self.config["auto_compact_interval_s"]))
+        if bool(self.config["enable_dbms_jobs"]):
+            self.jobs.start()
 
         # user store: mysql_native_password hashes (≙ __all_user);
         # root starts passwordless like a fresh deployment
@@ -110,6 +122,19 @@ class Database:
 
     def tenant(self, name: str = "sys") -> Tenant:
         return self.tenants[name]
+
+    @property
+    def tls_context(self):
+        """Lazily built server TLS context (self-signed credentials
+        persisted under <root>/tls; None for in-memory databases)."""
+        if self.root is None:
+            return None
+        ctx = getattr(self, "_tls_ctx", None)
+        if ctx is None:
+            from oceanbase_tpu.server.tls import server_context
+
+            ctx = self._tls_ctx = server_context(self.root)
+        return ctx
 
     # -- users (mysql_native_password credentials) -----------------------
     def create_user(self, name: str, password: str):
@@ -181,5 +206,6 @@ class Database:
 
     def close(self):
         self.ash.stop()
+        self.jobs.stop()
         for t in self.tenants.values():
             t.close()

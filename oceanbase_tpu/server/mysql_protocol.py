@@ -30,10 +30,12 @@ CLIENT_SECURE_CONNECTION = 0x8000
 CLIENT_PLUGIN_AUTH = 0x80000
 CLIENT_CONNECT_WITH_DB = 0x8
 CLIENT_TRANSACTIONS = 0x2000
+CLIENT_SSL = 0x800
 
 SERVER_CAPS = (CLIENT_LONG_PASSWORD | CLIENT_PROTOCOL_41 |
                CLIENT_SECURE_CONNECTION | CLIENT_PLUGIN_AUTH |
-               CLIENT_CONNECT_WITH_DB | CLIENT_TRANSACTIONS)
+               CLIENT_CONNECT_WITH_DB | CLIENT_TRANSACTIONS |
+               CLIENT_SSL)
 
 # column types
 T_DOUBLE, T_LONGLONG, T_DATE, T_NEWDECIMAL, T_VAR_STRING = 5, 8, 10, 246, 253
@@ -121,18 +123,30 @@ class _Conn:
         self.send(b"\xfe" + struct.pack("<HH", 0, 0x0002))
 
     # ---- handshake ------------------------------------------------------
+    def _tls_context(self):
+        try:
+            return (self.session.db.tls_context
+                    if self.session.db is not None else None)
+        except Exception:
+            return None  # e.g. cert generation unavailable
+
     def handshake(self) -> bool:
         # random 20-byte salt, ascii-safe (no NULs — the greeting is
         # NUL-delimited)
         salt = bytes(0x21 + (b % 0x5d) for b in os.urandom(20))
+        # only advertise TLS when a usable context exists: clients with
+        # ssl-mode=PREFERRED upgrade on seeing the flag and would hard-
+        # fail against an in-memory (certless) server
+        caps = SERVER_CAPS if self._tls_context() is not None \
+            else SERVER_CAPS & ~CLIENT_SSL
         greeting = (
             b"\x0a" + b"5.7.0-oceanbase-tpu\x00" +
             struct.pack("<I", threading.get_ident() & 0xFFFFFFFF) +
             salt[:8] + b"\x00" +
-            struct.pack("<H", SERVER_CAPS & 0xFFFF) +
+            struct.pack("<H", caps & 0xFFFF) +
             b"\x21" +                       # charset utf8
             struct.pack("<H", 0x0002) +     # status
-            struct.pack("<H", (SERVER_CAPS >> 16) & 0xFFFF) +
+            struct.pack("<H", (caps >> 16) & 0xFFFF) +
             bytes([21]) + b"\x00" * 10 + salt[8:] + b"\x00" +
             b"mysql_native_password\x00"
         )
@@ -141,6 +155,20 @@ class _Conn:
         resp = self.recv()
         if resp is None:
             return False
+        caps0 = struct.unpack_from("<I", resp, 0)[0] if len(resp) >= 4 \
+            else 0
+        if caps0 & CLIENT_SSL and len(resp) <= 32:
+            # SSLRequest: upgrade the socket to TLS, then read the real
+            # login over the encrypted channel (≙ the ussl-hook TLS
+            # upgrade on the mysql port, deps/ussl-hook)
+            ctx = self._tls_context()
+            if ctx is None:
+                self.send_err(3159, "server TLS is not configured")
+                return False
+            self.sock = ctx.wrap_socket(self.sock, server_side=True)
+            resp = self.recv()
+            if resp is None:
+                return False
         user, token = self._parse_handshake_response(resp)
         users = getattr(self.session.db, "users", None) \
             if self.session.db is not None else None

@@ -68,6 +68,7 @@ class Tenant:
 
         self.catalog = StorageCatalog(self.engine,
                                       snapshot_fn=self.tx.gts.current)
+        self.catalog._cache.resize(int(self.config["kv_cache_limit_bytes"]))
 
         # satellites: sequences, table locks, KV/CDC front-ends
         from oceanbase_tpu.share.sequence import SequenceManager
@@ -82,6 +83,8 @@ class Tenant:
         def _on_cfg(k, v):
             if k == "lock_wait_timeout_s":
                 self.tx.lock_wait_timeout_s = float(v)
+            elif k == "kv_cache_limit_bytes":
+                self.catalog._cache.resize(int(v))
 
         # hot-reload from the tenant overlay AND the cluster config
         self.config.watch(_on_cfg)

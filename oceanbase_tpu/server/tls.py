@@ -1,0 +1,56 @@
+"""TLS for the wire frontend: self-signed server credentials generated
+on first use and persisted under <root>/tls/.
+
+Reference analog: the ussl-hook TLS upgrade on the MySQL/RPC ports
+(deps/ussl-hook) + ALTER SYSTEM ssl configuration.  Operators can drop
+their own PEM pair at the same paths to replace the self-signed one.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import ssl
+
+
+def ensure_server_credentials(root: str) -> tuple[str, str]:
+    """-> (cert_path, key_path), generating a self-signed pair if absent."""
+    tdir = os.path.join(root, "tls")
+    cert_p = os.path.join(tdir, "server-cert.pem")
+    key_p = os.path.join(tdir, "server-key.pem")
+    if os.path.exists(cert_p) and os.path.exists(key_p):
+        return cert_p, key_p
+    os.makedirs(tdir, exist_ok=True)
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME,
+                                         "oceanbase-tpu")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(days=1))
+            .not_valid_after(now + datetime.timedelta(days=3650))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName("localhost")]), critical=False)
+            .sign(key, hashes.SHA256()))
+    with open(key_p, "wb") as fh:
+        fh.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption()))
+    with open(cert_p, "wb") as fh:
+        fh.write(cert.public_bytes(serialization.Encoding.PEM))
+    return cert_p, key_p
+
+
+def server_context(root: str) -> ssl.SSLContext:
+    cert_p, key_p = ensure_server_credentials(root)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_p, key_p)
+    return ctx

@@ -39,6 +39,8 @@ class VirtualTables:
             "v$wait_events": self.wait_events,
             "v$sql_workarea": self.sql_workarea,
             "v$errsim": self.errsim,
+            "v$dbms_jobs": self.dbms_jobs,
+            "v$kvcache": self.kvcache,
             "information_schema.tables": self.is_tables,
             "information_schema.columns": self.is_columns,
         }
@@ -196,6 +198,43 @@ class VirtualTables:
             "total_waits": np.array([c for c, _ in snap.values()], np.int64),
             "time_waited_s": np.array([t for _, t in snap.values()],
                                       np.float64),
+        }
+
+    def kvcache(self):
+        """Per-tenant device-relation cache stats
+        (≙ __all_virtual_kvcache_info)."""
+        rows = []
+        for tname, t in self.db.tenants.items():
+            st = t.catalog._cache.stats()
+            st["tenant"] = tname
+            rows.append(st)
+        return {
+            "tenant": _obj(r["tenant"] for r in rows),
+            "cache_name": _obj(r["name"] for r in rows),
+            "entries": np.array([r["entries"] for r in rows], np.int64),
+            "bytes": np.array([r["bytes"] for r in rows], np.int64),
+            "limit_bytes": np.array([r["limit_bytes"] for r in rows],
+                                    np.int64),
+            "hits": np.array([r["hits"] for r in rows], np.int64),
+            "misses": np.array([r["misses"] for r in rows], np.int64),
+            "evictions": np.array([r["evictions"] for r in rows],
+                                  np.int64),
+        }
+
+    def dbms_jobs(self):
+        """Scheduled-job registry + run history
+        (≙ DBA_SCHEDULER_JOBS / __all_virtual_dbms_job)."""
+        jobs = self.db.jobs.jobs
+        names = sorted(jobs)
+        return {
+            "job_name": _obj(names),
+            "interval_s": np.array([jobs[n]["interval"] for n in names],
+                                   np.float64),
+            "runs": np.array([jobs[n]["runs"] for n in names], np.int64),
+            "failures": np.array([jobs[n]["failures"] for n in names],
+                                 np.int64),
+            "last_run_s": np.array([jobs[n]["last_s"] for n in names],
+                                   np.float64),
         }
 
     def errsim(self):

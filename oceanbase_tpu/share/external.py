@@ -1,0 +1,154 @@
+"""External (lake) tables + Arrow interop.
+
+Reference analog: src/share/external_table (external table files scanned
+at query time), the lake connectors (src/sql/engine/connector), and the
+Arrow bridge (src/sql/engine/basic/ob_arrow_basic.h).
+
+Files read lazily at query time through pyarrow (CSV + Parquet), mapped
+into the engine's column domains: dates -> epoch days, DECIMAL -> scaled
+int64, strings -> object arrays (dictionary-encoded at device upload).
+``arrays_to_arrow`` exports a Result the other way.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from oceanbase_tpu.datatypes import SqlType, TypeKind, date_to_days
+
+
+def _coerce_arrow_column(arr, t: SqlType):
+    """One arrow ChunkedArray/Array -> (np array, valid|None) in the
+    STORAGE domain for SqlType t."""
+    import pyarrow as pa
+
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    valid = None
+    if arr.null_count:
+        valid = np.asarray(arr.is_valid())
+    k = t.kind
+    if k == TypeKind.STRING:
+        data = np.asarray(arr.cast(pa.string()).to_pylist(), dtype=object)
+        data = np.array([v if v is not None else "" for v in data],
+                        dtype=object)
+        return data, valid
+    if k == TypeKind.DATE:
+        if pa.types.is_date32(arr.type) or pa.types.is_date64(arr.type):
+            days = arr.cast(pa.date32()).cast(pa.int32())
+            data = np.asarray(days.to_numpy(zero_copy_only=False))
+        else:
+            data = np.array([date_to_days(str(v)) if v is not None else 0
+                             for v in arr.to_pylist()], dtype=np.int32)
+        return data.astype(np.int32), valid
+    if k == TypeKind.DECIMAL:
+        scale = 10 ** t.scale
+        vals = arr.to_pylist()
+        data = np.array([int(round(float(v) * scale)) if v is not None
+                         else 0 for v in vals], dtype=np.int64)
+        return data, valid
+    if k in (TypeKind.DOUBLE, TypeKind.FLOAT):
+        data = np.asarray(arr.cast(pa.float64())
+                          .to_numpy(zero_copy_only=False))
+        return np.nan_to_num(data), valid
+    if k == TypeKind.BOOL:
+        data = np.asarray(arr.cast(pa.bool_())
+                          .to_numpy(zero_copy_only=False))
+        return np.where(np.asarray(valid, bool) if valid is not None
+                        else True, data, False).astype(bool), valid
+    data = np.asarray(arr.cast(pa.int64()).to_numpy(zero_copy_only=False))
+    if valid is not None:
+        data = np.where(valid, data, 0)
+    return data.astype(np.int64), valid
+
+
+def arrow_to_arrays(table, tdef=None):
+    """pyarrow Table -> (arrays, valids, types) keyed by column name.
+    With a tdef the declared SqlTypes drive coercion; otherwise types
+    infer from the arrow schema."""
+    import pyarrow as pa
+
+    arrays, valids, types = {}, {}, {}
+    for i, field in enumerate(table.schema):
+        name = field.name
+        if tdef is not None:
+            t = tdef.column(name).dtype
+        else:
+            at = field.type
+            if pa.types.is_string(at) or pa.types.is_large_string(at):
+                t = SqlType.string()
+            elif pa.types.is_floating(at):
+                t = SqlType.double()
+            elif pa.types.is_date(at):
+                t = SqlType.date()
+            elif pa.types.is_decimal(at):
+                t = SqlType.decimal(at.precision, at.scale)
+            elif pa.types.is_boolean(at):
+                t = SqlType.bool_()
+            else:
+                t = SqlType.int_()
+        data, valid = _coerce_arrow_column(table.column(i), t)
+        arrays[name] = data
+        if valid is not None:
+            valids[name] = valid
+        types[name] = t
+    return arrays, valids, types
+
+
+def read_external(location: str, fmt: str, tdef, delimiter: str = ",",
+                  skip_lines: int = 0):
+    """Read one external file -> (arrays, valids, types)."""
+    import pyarrow as pa
+
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(location,
+                              columns=[c.name for c in tdef.columns])
+        return arrow_to_arrays(table, tdef)
+    if fmt == "csv":
+        import pyarrow.csv as pacsv
+
+        names = [c.name for c in tdef.columns]
+        table = pacsv.read_csv(
+            location,
+            read_options=pacsv.ReadOptions(
+                column_names=names, skip_rows=skip_lines),
+            parse_options=pacsv.ParseOptions(delimiter=delimiter),
+            convert_options=pacsv.ConvertOptions(
+                column_types={c.name: pa.string()
+                              for c in tdef.columns
+                              if c.dtype.kind in (TypeKind.STRING,
+                                                  TypeKind.DATE,
+                                                  TypeKind.DECIMAL)}))
+        return arrow_to_arrays(table, tdef)
+    raise ValueError(f"unsupported external format {fmt!r}")
+
+
+def result_to_arrow(result):
+    """Result -> pyarrow Table (the Arrow export boundary)."""
+    import pyarrow as pa
+
+    cols, names = [], []
+    for name in result.names:
+        a = result.arrays[name]
+        v = result.valids.get(name)
+        t = result.dtypes.get(name)
+        if t is not None and t.kind == TypeKind.DECIMAL:
+            vals = [None if (v is not None and not v[i])
+                    else float(a[i]) / (10 ** t.scale)
+                    for i in range(len(a))]
+            cols.append(pa.array(vals, type=pa.float64()))
+        elif t is not None and t.kind == TypeKind.DATE:
+            from oceanbase_tpu.datatypes import days_to_date
+
+            vals = [None if (v is not None and not v[i])
+                    else days_to_date(int(a[i])) for i in range(len(a))]
+            cols.append(pa.array(vals, type=pa.string()))
+        else:
+            vals = [None if (v is not None and not v[i]) else
+                    (a[i].item() if hasattr(a[i], "item") else a[i])
+                    for i in range(len(a))]
+            cols.append(pa.array(vals))
+        names.append(name)
+    return pa.table(dict(zip(names, cols)))

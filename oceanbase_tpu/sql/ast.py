@@ -288,3 +288,61 @@ class SavepointStmt:
 
     op: str      # create | rollback | release
     name: str = ""
+
+@dataclass
+class CreateExternalTableStmt:
+    """CREATE EXTERNAL TABLE name (cols) LOCATION 'path' [FORMAT csv|
+    parquet] [FIELDS TERMINATED BY c] [IGNORE n LINES]
+    (≙ src/share/external_table + the lake connectors)."""
+
+    name: str
+    columns: list                 # list[ColumnSpec]
+    location: str = ""
+    format: str = "csv"
+    delimiter: str = ","
+    skip_lines: int = 0
+    if_not_exists: bool = False
+
+# ---- PL (stored procedures) -------------------------------------------------
+
+@dataclass
+class PlDeclare:
+    name: str
+    dtype: SqlType = None
+    default: object = None   # ir.Expr | None
+
+
+@dataclass
+class PlSet:
+    name: str
+    expr: object             # ir.Expr
+
+
+@dataclass
+class PlIf:
+    branches: list           # list[(cond ir.Expr, [body])]
+    else_: list = field(default_factory=list)
+
+
+@dataclass
+class PlWhile:
+    cond: object             # ir.Expr
+    body: list = field(default_factory=list)
+
+
+@dataclass
+class ProcedureStmt:
+    """CREATE/DROP PROCEDURE (≙ src/pl compilation units; here an
+    interpreted statement list over the same expression engine)."""
+
+    op: str                  # create | drop
+    name: str = ""
+    params: list = field(default_factory=list)  # [(name, SqlType)]
+    body: list = field(default_factory=list)    # PL nodes / statements
+    source: str = ""         # original text (persistence + SHOW)
+
+
+@dataclass
+class CallStmt:
+    name: str
+    args: list = field(default_factory=list)    # list[ir.Expr]

@@ -923,6 +923,13 @@ class _CorrelationCollector:
         from oceanbase_tpu.sql.optimizer import build_join_tree
 
         plan, est, _ = build_join_tree(qb, b.catalog)
+        # predicates nested rewrites parked on the block (a correlated
+        # scalar comparison becomes a post-join filter) MUST apply here —
+        # dropping them silently widens the subquery (TPC-H Q20's
+        # availqty > 0.5*sum filter lives exactly here)
+        for pred in qb.post_preds:
+            plan = pp.Filter(plan, pred)
+            est = max(1, est // 3)
 
         # bind select items (inner scope)
         items = []

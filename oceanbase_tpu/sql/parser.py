@@ -41,6 +41,7 @@ class Interval(ir.Expr):
 
 class Parser:
     def __init__(self, sql: str):
+        self.sql = sql
         self.toks = tokenize(sql)
         self.i = 0
         self.n_params = 0
@@ -125,6 +126,22 @@ class Parser:
                     self.peek(1).value == "sequence":
                 return self.parse_sequence("create")
             return self.parse_create()
+        if self.peek().kind == "ident" and self.peek().value == "call":
+            self.next()
+            name = self.expect_ident()
+            args = []
+            if self.accept_op("("):
+                if not self.at_op(")"):
+                    args.append(self.parse_expr())
+                    while self.accept_op(","):
+                        args.append(self.parse_expr())
+                self.expect_op(")")
+            return ast.CallStmt(name, args)
+        if self.at_kw("drop") and self.peek(1).kind == "ident" and \
+                self.peek(1).value == "procedure":
+            self.next()
+            self.next()
+            return ast.ProcedureStmt("drop", self.expect_ident())
         if self.at_kw("drop"):
             if self.peek(1).kind == "kw" and self.peek(1).value == "tenant":
                 self.next()
@@ -717,6 +734,9 @@ class Parser:
             terms = self._string_lit()
             if self.accept_kw("in"):
                 while not self.at_op(")"):
+                    if self.peek().kind == "eof":
+                        raise ParseError(
+                            "unterminated MATCH ... AGAINST mode")
                     self.next()
             self.expect_op(")")
             return ir.FuncCall("match_against",
@@ -1045,6 +1065,138 @@ class Parser:
                                    if_not_exists, kind=kind,
                                    options=options)
 
+    def parse_create_external(self):
+        """CREATE EXTERNAL TABLE name (cols) LOCATION 'p' [FORMAT f]
+        [FIELDS TERMINATED BY c] [IGNORE n LINES]."""
+        self.expect_kw("table")
+        if_not_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self.expect_ident()
+        self.expect_op("(")
+        cols = []
+        while True:
+            cname = self.expect_ident()
+            dtype = self.parse_type()
+            cols.append(ast.ColumnSpec(cname, dtype))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        if not self._accept_word("location"):
+            raise ParseError("external table requires LOCATION 'path'")
+        location = self._string_lit()
+        fmt = "parquet" if location.endswith(".parquet") else "csv"
+        delimiter, skip = ",", 0
+        while True:
+            if self._accept_word("format"):
+                t = self.next()
+                fmt = t.value.lower()
+            elif self._accept_word("fields"):
+                if not self._accept_word("terminated"):
+                    raise ParseError("expected TERMINATED BY")
+                self.expect_kw("by")
+                delimiter = self._string_lit()
+            elif self._accept_word("ignore"):
+                t = self.next()
+                skip = int(t.value)
+                if not self._accept_word("lines"):
+                    raise ParseError("expected LINES")
+            else:
+                break
+        return ast.CreateExternalTableStmt(
+            name, cols, location=location, format=fmt,
+            delimiter=delimiter, skip_lines=skip,
+            if_not_exists=if_not_exists)
+
+    # ---- PL: stored procedures ----------------------------------------
+    def parse_create_procedure(self):
+        """CREATE PROCEDURE name([IN] p TYPE, ...) BEGIN stmts END."""
+        name = self.expect_ident()
+        params = []
+        self.expect_op("(")
+        if not self.at_op(")"):
+            while True:
+                self._accept_word("in")  # IN is the only supported mode
+                pname = self.expect_ident()
+                ptype = self.parse_type()
+                params.append((pname, ptype))
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        self.expect_kw("begin")
+        body = self.parse_pl_block(("end",))
+        self.expect_kw("end")
+        # the statement's own text is the persisted definition (reparsed
+        # at boot) — never infer it from session state
+        return ast.ProcedureStmt("create", name, params, body,
+                                 source=self.sql)
+
+    def parse_pl_block(self, stops: tuple) -> list:
+        """Statements until one of ``stops`` keywords (not consumed)."""
+        body = []
+        while True:
+            t = self.peek()
+            if t.kind == "eof" or (t.kind in ("kw", "ident")
+                                   and t.value in stops):
+                return body
+            body.append(self.parse_pl_statement())
+            self.accept_op(";")
+
+    def parse_pl_statement(self):
+        t = self.peek()
+        if t.kind == "ident" and t.value == "declare":
+            self.next()
+            name = self.expect_ident()
+            dtype = self.parse_type()
+            default = None
+            if self._accept_word("default"):
+                default = self.parse_expr()
+            return ast.PlDeclare(name, dtype, default)
+        if self.at_kw("if"):
+            self.next()
+            branches = []
+            cond = self.parse_expr()
+            if not self._accept_word("then"):
+                raise ParseError("expected THEN")
+            branches.append((cond, self.parse_pl_block(
+                ("elseif", "else", "end"))))
+            else_ = []
+            while True:
+                if self._accept_word("elseif"):
+                    c = self.parse_expr()
+                    if not self._accept_word("then"):
+                        raise ParseError("expected THEN")
+                    branches.append((c, self.parse_pl_block(
+                        ("elseif", "else", "end"))))
+                    continue
+                if self.accept_kw("else"):
+                    else_ = self.parse_pl_block(("end",))
+                break
+            self.expect_kw("end")
+            self.expect_kw("if")
+            return ast.PlIf(branches, else_)
+        if self.peek().kind in ("kw", "ident") and \
+                self.peek().value == "while":
+            self.next()
+            cond = self.parse_expr()
+            if not self._accept_word("do"):
+                raise ParseError("expected DO")
+            body = self.parse_pl_block(("end",))
+            self.expect_kw("end")
+            if not self._accept_word("while"):
+                raise ParseError("expected WHILE after END")
+            return ast.PlWhile(cond, body)
+        if self.at_kw("set") and self.peek(1).kind == "ident" and \
+                self.peek(2).kind == "op" and self.peek(2).value == "=":
+            # SET var = expr (PL variable assignment)
+            self.next()
+            name = self.expect_ident()
+            self.expect_op("=")
+            return ast.PlSet(name, self.parse_expr())
+        return self.parse_statement()
+
     def parse_create(self):
         self.expect_kw("create")
         unique = False
@@ -1059,6 +1211,14 @@ class Parser:
             return self.parse_create_index(unique, kind)
         if unique or kind != "normal":
             raise ParseError("expected INDEX")
+        if self.peek().kind == "ident" and \
+                self.peek().value == "external":
+            self.next()
+            return self.parse_create_external()
+        if self.peek().kind == "ident" and \
+                self.peek().value == "procedure":
+            self.next()
+            return self.parse_create_procedure()
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):

@@ -209,7 +209,18 @@ class Session:
         if isinstance(stmt, ast.CreateTableStmt):
             return self._create_table(stmt)
         if isinstance(stmt, ast.DropTableStmt):
+            if self.catalog.drop_external(stmt.name):
+                return _ok()
             self.catalog.drop_table(stmt.name, if_exists=stmt.if_exists)
+            return _ok()
+        if isinstance(stmt, ast.CreateExternalTableStmt):
+            td = TableDef(stmt.name,
+                          [ColumnDef(c.name, c.dtype, c.nullable)
+                           for c in stmt.columns])
+            self.catalog.register_external(
+                td, stmt.location, fmt=stmt.format,
+                delimiter=stmt.delimiter, skip_lines=stmt.skip_lines,
+                if_not_exists=stmt.if_not_exists)
             return _ok()
         if isinstance(stmt, ast.CreateIndexStmt):
             return self._create_index(stmt)
@@ -244,6 +255,10 @@ class Session:
             return self._tx_control(stmt.op)
         if isinstance(stmt, ast.SavepointStmt):
             return self._savepoint(stmt)
+        if isinstance(stmt, ast.ProcedureStmt):
+            return self._procedure_ddl(stmt)
+        if isinstance(stmt, ast.CallStmt):
+            return self._call_procedure(stmt, params)
         if isinstance(stmt, ast.SetVarStmt):
             return self._set_var(stmt)
         if isinstance(stmt, ast.AlterSystemStmt):
@@ -858,9 +873,19 @@ class Session:
         vecs = _np.asarray(colv.data)
         if rel.mask is not None and not bool(_np.asarray(rel.mask).all()):
             return None  # dead rows would need an id remap; skip
-        idx = IvfFlatIndex(vecs, metric=metric) if len(vecs) >= 100_000 \
-            else jnp.asarray(vecs)
-        cache[key] = (ver, idx)
+        # IVF (approximate recall) ONLY when the index opted in with
+        # WITH (approximate = true) — index DDL must never silently
+        # change the answers of an unchanged exact query
+        td = self.catalog.table_def(table)
+        approx = any(v["kind"] == "vector" and v["column"] == col
+                     and v.get("options", {}).get("approximate")
+                     for v in td.aux_indexes.values())
+        idx = IvfFlatIndex(vecs, metric=metric) \
+            if approx and len(vecs) >= 4096 else jnp.asarray(vecs)
+        # the cache entry holds the source Relation too: identity-keyed
+        # versions (catalog-only tables) must keep the object alive or a
+        # recycled id would serve a stale index
+        cache[key] = (ver, idx, rel)
         return idx
 
     def _index_prefilter(self, plan, tables) -> dict:
@@ -1443,6 +1468,183 @@ class Session:
         for t in stmt_writes:
             self.catalog.invalidate(t)
         return _ok()
+
+    # ------------------------------------------------------------------
+    # stored procedures (interpreted PL subset; ≙ src/pl — DECLARE/SET/
+    # IF/WHILE over the shared expression engine, SQL via the session)
+    # ------------------------------------------------------------------
+    def _proc_store(self) -> dict:
+        if self.db is not None:
+            if not hasattr(self.db, "procedures"):
+                self.db.procedures = {}
+                self._load_procs()
+            return self.db.procedures
+        if not hasattr(self, "_procs"):
+            self._procs = {}
+        return self._procs
+
+    def _procs_path(self):
+        import os
+
+        return (os.path.join(self.db.root, "procedures.json")
+                if self.db is not None and self.db.root else None)
+
+    def _load_procs(self):
+        import json
+        import os
+
+        p = self._procs_path()
+        if p and os.path.exists(p):
+            with open(p) as fh:
+                for name, src in json.load(fh).items():
+                    stmt = parse_sql(src)
+                    stmt.source = src
+                    self.db.procedures[name] = stmt
+
+    def _persist_procs(self):
+        import json
+        import os
+
+        p = self._procs_path()
+        if not p:
+            return
+        store = self._proc_store()
+        tmp = p + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({n: s.source for n, s in store.items()}, fh)
+        os.replace(tmp, p)
+
+    def _procedure_ddl(self, stmt: ast.ProcedureStmt) -> Result:
+        store = self._proc_store()
+        if stmt.op == "drop":
+            if store.pop(stmt.name, None) is None:
+                raise KeyError(f"unknown procedure {stmt.name}")
+        else:
+            if stmt.name in store:
+                raise ValueError(f"procedure {stmt.name} exists")
+            if not stmt.source:
+                raise ValueError(
+                    "procedure definition lost its source text")
+            store[stmt.name] = stmt
+        self._persist_procs()
+        return _ok()
+
+    def _call_procedure(self, stmt: ast.CallStmt, params) -> Result:
+        from oceanbase_tpu.expr.compile import literal_value
+
+        proc = self._proc_store().get(stmt.name)
+        if proc is None:
+            raise KeyError(f"unknown procedure {stmt.name}")
+        if len(stmt.args) != len(proc.params):
+            raise ValueError(
+                f"{stmt.name} expects {len(proc.params)} arguments")
+        env: dict = {}
+        for (pname, ptype), arg in zip(proc.params, stmt.args):
+            v, t = literal_value(_as_literal(arg, params, None))
+            env[pname] = _coerce_value(v, t, ptype)
+        out = [None]
+        self._pl_exec(proc.body, env, out, depth=0)
+        return out[0] if out[0] is not None else _ok()
+
+    _PL_MAX_ITERS = 100_000
+
+    def _pl_eval(self, expr, env: dict):
+        """Evaluate a PL expression over the variable environment via
+        the shared expression engine (a 1-row relation of vars)."""
+        from oceanbase_tpu.expr.compile import eval_expr
+        from oceanbase_tpu.vector import from_numpy, to_numpy
+
+        arrays = {}
+        valids = {}
+        for k, v in env.items():
+            if v is None:
+                arrays[k] = np.zeros(1, np.int64)
+                valids[k] = np.zeros(1, bool)
+            elif isinstance(v, str):
+                arrays[k] = np.array([v], dtype=object)
+            elif isinstance(v, float):
+                arrays[k] = np.array([v], np.float64)
+            else:
+                arrays[k] = np.array([int(v)], np.int64)
+        arrays.setdefault("__one__", np.ones(1, np.int64))
+        rel = from_numpy(arrays, valids=valids or None)
+        c = eval_expr(expr, rel)
+        raw = to_numpy(type(rel)(columns={"r": c}, mask=rel.mask))
+        x = raw["r"][0]
+        vmask = raw.get("__valid__r")
+        if vmask is not None and not vmask[0]:
+            return None
+        return x.item() if hasattr(x, "item") else x
+
+    def _pl_subst(self, node, env: dict):
+        """Deep-substitute PL variables (bare ColumnRefs matching env
+        names) with literals inside a statement AST."""
+        import copy
+
+        def sub_expr(e):
+            if isinstance(e, ir.ColumnRef) and e.name in env:
+                return ir.Literal(env[e.name])
+            if isinstance(e, ir.Expr):
+                e2 = copy.copy(e)
+                for f, v in vars(e).items():
+                    setattr(e2, f, sub_any(v))
+                return e2
+            return e
+
+        def sub_any(v):
+            if isinstance(v, ir.Expr):
+                return sub_expr(v)
+            if isinstance(v, list):
+                return [sub_any(x) for x in v]
+            if isinstance(v, tuple):
+                return tuple(sub_any(x) for x in v)
+            if hasattr(v, "__dataclass_fields__"):
+                v2 = copy.copy(v)
+                for f in v.__dataclass_fields__:
+                    setattr(v2, f, sub_any(getattr(v, f)))
+                return v2
+            return v
+
+        return sub_any(node)
+
+    def _pl_exec(self, body: list, env: dict, out: list, depth: int):
+        if depth > 64:
+            raise RecursionError("PL nesting too deep")
+        for item in body:
+            if isinstance(item, ast.PlDeclare):
+                env[item.name] = (self._pl_eval(item.default, env)
+                                  if item.default is not None else None)
+            elif isinstance(item, ast.PlSet):
+                env[item.name] = self._pl_eval(item.expr, env)
+            elif isinstance(item, ast.PlIf):
+                done = False
+                for cond, blk in item.branches:
+                    if bool(self._pl_eval(cond, env)):
+                        self._pl_exec(blk, env, out, depth + 1)
+                        done = True
+                        break
+                if not done and item.else_:
+                    self._pl_exec(item.else_, env, out, depth + 1)
+            elif isinstance(item, ast.PlWhile):
+                iters = 0
+                while bool(self._pl_eval(item.cond, env)):
+                    self._pl_exec(item.body, env, out, depth + 1)
+                    iters += 1
+                    if iters > self._PL_MAX_ITERS:
+                        raise RuntimeError("PL WHILE iteration limit")
+            else:
+                # body statements must NOT hit the plan cache under the
+                # CALL statement's text (its key would collide across
+                # different/iterating SELECTs) — blank the audit text
+                saved = self._ash_state.get("sql", "")
+                self._ash_state["sql"] = ""
+                try:
+                    res = self.execute_stmt(self._pl_subst(item, env),
+                                            None)
+                finally:
+                    self._ash_state["sql"] = saved
+                if res is not None and res.names:
+                    out[0] = res
 
     def _run_in_tx(self, fn, tx_hint=None):
         """Run fn(tx) in the active explicit transaction (with

@@ -723,14 +723,68 @@ class StorageCatalog(Catalog):
         self.engine = engine
         # snapshot provider (GTS reader); default: latest
         self.snapshot_fn = snapshot_fn or (lambda: 2**62)
-        self._cache: dict[str, tuple] = {}  # name -> (data_version, Relation)
+        # device-relation cache: decoded HBM-resident columns behind a
+        # byte-bounded LRU (≙ ObKVGlobalCache block cache,
+        # src/share/cache/ob_kv_storecache.h:91)
+        from oceanbase_tpu.share.kvcache import KvCache
+
+        self._cache = KvCache(limit_bytes=2 << 30, name="relation")
         # surface engine-persisted tables in the catalog
         for name, ts in engine.tables.items():
             self._defs[name] = ts.tdef
+        self._load_externals()
+
+    # -- external tables persist with the engine root -------------------
+    def _externals_path(self):
+        return (os.path.join(self.engine.root, "externals.json")
+                if self.engine.root else None)
+
+    def _load_externals(self):
+        p = self._externals_path()
+        if not p or not os.path.exists(p):
+            return
+        with open(p) as f:
+            for name, e in json.load(f).items():
+                cols = [ColumnDef(n, SqlType(TypeKind(k), pr, sc), nl)
+                        for n, k, pr, sc, nl in e["columns"]]
+                self._externals[name] = {
+                    "tdef": TableDef(name, cols),
+                    "location": e["location"], "format": e["format"],
+                    "delimiter": e["delimiter"], "skip": e["skip"],
+                    "cache": None}
+
+    def _persist_externals(self):
+        p = self._externals_path()
+        if not p:
+            return
+        out = {}
+        with self._lock:
+            for name, e in self._externals.items():
+                out[name] = {
+                    "columns": [[c.name, c.dtype.kind.value,
+                                 c.dtype.precision, c.dtype.scale,
+                                 c.nullable]
+                                for c in e["tdef"].columns],
+                    "location": e["location"], "format": e["format"],
+                    "delimiter": e["delimiter"], "skip": e["skip"]}
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, p)
+
+    def register_external(self, tdef, location, **kw):
+        super().register_external(tdef, location, **kw)
+        self._persist_externals()
+
+    def drop_external(self, name: str) -> bool:
+        out = super().drop_external(name)
+        if out:
+            self._persist_externals()
+        return out
 
     def create_table(self, tdef: TableDef, if_not_exists: bool = False):
         with self._lock:
-            if tdef.name in self._defs:
+            if tdef.name in self._defs or tdef.name in self._externals:
                 if if_not_exists:
                     return
                 raise ValueError(f"table {tdef.name} already exists")
@@ -746,7 +800,7 @@ class StorageCatalog(Catalog):
                 raise KeyError(name)
             self.engine.drop_table(name)
             self._defs.pop(name, None)
-            self._cache.pop(name, None)
+            self._cache.invalidate(name)
             self.schema_version += 1
 
     # -- engine is the source of truth for defs: WAL apply on a replica
@@ -756,6 +810,9 @@ class StorageCatalog(Catalog):
             t = self._transients.get(name)
             if t is not None:
                 return t[0]
+            e = self._externals.get(name)
+            if e is not None:
+                return e["tdef"]
             ts = self.engine.tables.get(name)
             if ts is not None:
                 self._defs[name] = ts.tdef
@@ -765,12 +822,14 @@ class StorageCatalog(Catalog):
 
     def has_table(self, name: str) -> bool:
         with self._lock:
-            return name in self._transients or name in self.engine.tables
+            return name in self._transients or \
+                name in self._externals or name in self.engine.tables
 
     def tables(self) -> list[str]:
         with self._lock:
-            return sorted(n for n in self.engine.tables
-                          if not n.startswith("__idx__"))
+            return sorted([n for n in self.engine.tables
+                           if not n.startswith("__idx__")]
+                          + list(self._externals))
 
     def load_numpy(self, name, arrays, types=None, primary_key=None,
                    valids=None):
@@ -812,11 +871,13 @@ class StorageCatalog(Catalog):
                                      rel.capacity)
                 self._defs[name].ndv[c.name] = nd
             self.schema_version += 1
-            self._cache.pop(name, None)
+            self._cache.invalidate(name)
 
     def table_data(self, name):
         from oceanbase_tpu.vector import from_numpy
 
+        if name in self._externals:
+            return self._external_data(name)
         with self._lock:
             t = self._transients.get(name)
             if t is not None:
@@ -847,7 +908,10 @@ class StorageCatalog(Catalog):
                            for s, _ in ts.tablet.segment_locations()),
                           default=0)
             if snap >= seg_max:
-                self._cache[name] = (ver, rel)
+                from oceanbase_tpu.share.kvcache import relation_bytes
+
+                self._cache.put(name, (ver, rel),
+                                nbytes=relation_bytes(rel))
             ts.tdef.row_count = rel.capacity
             return rel
 
@@ -856,6 +920,8 @@ class StorageCatalog(Catalog):
         read path active transactions use."""
         from oceanbase_tpu.vector import from_numpy
 
+        if name in self._externals:
+            return self._external_data(name)
         with self._lock:
             # last-writer-wins is fine for transients (virtual tables are
             # monotonic snapshots), but the lookup itself must be locked
@@ -904,4 +970,4 @@ class StorageCatalog(Catalog):
 
     def invalidate(self, name: str):
         with self._lock:
-            self._cache.pop(name, None)
+            self._cache.invalidate(name)

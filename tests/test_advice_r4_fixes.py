@@ -174,3 +174,42 @@ def test_tx_snapshot_isolation_through_spill_tier(tmp_path):
     r = s.execute("select count(*) from t")
     assert r.rows()[0][0] == N + 1
     db.close()
+
+
+def test_nested_scalar_subquery_filter_not_dropped():
+    """TPC-H Q20 shape: a correlated scalar comparison nested inside an
+    IN-subquery must filter the SAME rows the sibling IN predicate
+    filters — the decorrelation used to drop the comparison entirely
+    (SF1 parity Q20 off-by-one)."""
+    import numpy as np
+
+    from oceanbase_tpu.sql import Session
+
+    s = Session()
+    s.catalog.load_numpy("supplier", {
+        "s_suppkey": np.array([1, 2]),
+        "s_name": np.array(["sup1", "sup2"], dtype=object)},
+        primary_key=["s_suppkey"])
+    s.catalog.load_numpy("partsupp", {
+        "ps_partkey": np.array([10, 20, 30]),
+        "ps_suppkey": np.array([1, 1, 2]),
+        "ps_availqty": np.array([1, 1000, 1000])}, primary_key=[])
+    s.catalog.load_numpy("part", {
+        "p_partkey": np.array([10, 30]),
+        "p_name": np.array(["forest a", "forest b"], dtype=object)},
+        primary_key=["p_partkey"])
+    s.catalog.load_numpy("lineitem", {
+        "l_partkey": np.array([10, 20, 30]),
+        "l_suppkey": np.array([1, 1, 2]),
+        "l_quantity": np.array([100.0, 1.0, 4.0])}, primary_key=[])
+    r = s.execute(
+        "select s_name from supplier where s_suppkey in ("
+        " select ps_suppkey from partsupp"
+        " where ps_partkey in (select p_partkey from part"
+        "                      where p_name like 'forest%')"
+        "   and ps_availqty > (select 0.5 * sum(l_quantity)"
+        "                      from lineitem"
+        "                      where l_partkey = ps_partkey"
+        "                        and l_suppkey = ps_suppkey)"
+        ") order by s_name")
+    assert r.rows() == [("sup2",)]

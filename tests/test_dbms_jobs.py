@@ -1,0 +1,69 @@
+"""DBMS job scheduler: auto stats gather, auto compaction, custom SQL
+jobs, v$dbms_jobs (≙ src/observer/dbms_job + dbms_scheduler).
+"""
+
+import time
+
+import numpy as np
+
+from oceanbase_tpu.server import Database
+
+
+def test_stats_auto_gather(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values " + ", ".join(
+        f"({i}, {i % 7})" for i in range(500)))
+    db.jobs.tick_s = 0.05
+    db.jobs.schedule_fn("stats_gather", 0.1, db.jobs._stats_gather)
+    db.jobs.start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        td = s.catalog.table_def("t")
+        if td.ndv.get("v") == 7:  # exact NDV only comes from ANALYZE
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("stats job never gathered exact NDV")
+    r = s.execute("select job_name, runs from v$dbms_jobs "
+                  "where job_name = 'stats_gather'")
+    assert r.rows()[0][1] >= 1
+    db.close()
+
+
+def test_custom_sql_job(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table log (k int primary key auto_increment, "
+              "v int)")
+    db.jobs.tick_s = 0.05
+    db.jobs.schedule("writer", 0.1, "insert into log (v) values (1)")
+    db.jobs.start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if s.execute("select count(*) from log").rows()[0][0] >= 2:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("custom job never ran twice")
+    db.jobs.cancel("writer")
+    db.close()
+
+
+def test_job_failure_recorded(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    db.jobs.tick_s = 0.05
+    db.jobs.schedule("bad", 0.1, "select * from missing_table")
+    db.jobs.start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        j = db.jobs.jobs.get("bad")
+        if j and j["failures"] >= 1:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("failure never recorded")
+    assert any(h["job"] == "bad" and not h["ok"]
+               for h in db.jobs.history)
+    db.close()

@@ -139,3 +139,21 @@ def test_extended_function_batch():
 
     got = s.execute("select md5(s) from fx order by k limit 1").rows()[0][0]
     assert got == hashlib.md5(b"abc").hexdigest()
+
+
+def test_concat_ws_skips_nulls():
+    """MySQL CONCAT_WS semantics: NULL values are skipped with their
+    separator (unlike CONCAT's null propagation)."""
+    import numpy as np
+
+    from oceanbase_tpu.sql import Session
+
+    s = Session()
+    s.catalog.load_numpy(
+        "cw", {"k": np.arange(3),
+               "a": np.array(["x", "y", "z"], dtype=object),
+               "b": np.array(["1", "", "3"], dtype=object)},
+        valids={"b": np.array([True, False, True])},
+        primary_key=["k"])
+    r = s.execute("select concat_ws('-', a, b) from cw order by k")
+    assert [x[0] for x in r.rows()] == ["x-1", "y", "z-3"]

@@ -1,0 +1,43 @@
+"""Bounded KV cache: LRU eviction, byte budget, stats, v$kvcache
+(≙ src/share/cache/ob_kv_storecache.h ObKVGlobalCache)."""
+
+import numpy as np
+
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.share.kvcache import KvCache
+
+
+def test_lru_eviction_and_stats():
+    c = KvCache(limit_bytes=100, name="t")
+    c.put("a", "va", nbytes=40)
+    c.put("b", "vb", nbytes=40)
+    assert c.get("a") == "va"          # touch a -> b is LRU
+    c.put("c", "vc", nbytes=40)        # evicts b
+    assert c.get("b") is None
+    assert c.get("a") == "va" and c.get("c") == "vc"
+    st = c.stats()
+    assert st["evictions"] == 1 and st["entries"] == 2
+    assert st["bytes"] <= 100
+    # oversized values are refused, not cached
+    c.put("huge", "x", nbytes=1000)
+    assert c.get("huge") is None
+    c.resize(40)
+    assert c.stats()["entries"] == 1
+
+
+def test_catalog_cache_behind_kvcache(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values " + ", ".join(
+        f"({i}, {i})" for i in range(1000)))
+    s.execute("select sum(v) from t")
+    s.execute("select sum(v) from t")   # second read hits the cache
+    r = s.execute("select cache_name, hits, bytes from v$kvcache "
+                  "where tenant = 'sys'")
+    rows = r.rows()
+    assert rows and rows[0][1] >= 1 and rows[0][2] > 0
+    # resizing to nothing evicts (ALTER SYSTEM hot-reload path)
+    s.execute("alter system set kv_cache_limit_bytes = 1")
+    assert db.tenant("sys").catalog._cache.stats()["entries"] == 0
+    db.close()

@@ -273,3 +273,38 @@ def test_set_password(server):
                     password="new")
     assert c2.ping()
     c2.close()
+
+
+def test_tls_upgrade(server):
+    """SSLRequest upgrade: TLS handshake mid-protocol, then normal auth
+    and queries over the encrypted channel (≙ ussl-hook TLS upgrade)."""
+    import ssl
+
+    c = MiniClient.__new__(MiniClient)
+    c.sock = socket.create_connection((server.host, server.port),
+                                      timeout=10)
+    c.seq = 0
+    c.user, c.password = "root", ""
+    greeting = c._read_packet()
+    assert greeting[0] == 0x0A
+    # capability flags advertise SSL
+    p = greeting.index(b"\x00", 1) + 1 + 4 + 8 + 1
+    caps_lo = struct.unpack_from("<H", greeting, p)[0]
+    assert caps_lo & 0x800, "server must advertise CLIENT_SSL"
+    # send SSLRequest (caps with CLIENT_SSL, no username)
+    caps = 0x0200 | 0x8000 | 0x800
+    c._send(struct.pack("<IIB", caps, 1 << 24, 0x21) + b"\x00" * 23)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    c.sock = ctx.wrap_socket(c.sock)
+    assert c.sock.version() is not None  # TLS established
+    # now the real login over TLS
+    c._send(struct.pack("<IIB", caps, 1 << 24, 0x21) + b"\x00" * 23 +
+            b"root\x00" + b"\x00")
+    ok = c._read_packet()
+    assert ok[0] == 0x00, ok
+    c.query("create table tt (k int primary key)")
+    c.query("insert into tt values (1), (2)")
+    assert c.query("select count(*) from tt")["rows"] == [("2",)]
+    c.close()

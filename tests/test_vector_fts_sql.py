@@ -143,3 +143,26 @@ def test_empty_vector_table_create():
         types={"v": __import__("oceanbase_tpu.datatypes",
                                fromlist=["SqlType"]).SqlType.vector(3)})
     assert s.catalog.table_def("ev").column("v").dtype.precision == 3
+
+
+def test_vector_index_approximate_opt_in():
+    """IVF recall only engages when the index opts in WITH
+    (approximate = true); a plain vector index keeps exact answers."""
+    import numpy as np
+
+    s, vecs = _vec_env(n=5000, d=8, seed=4)
+    s.execute("create vector index ia on emb (v) "
+              "with (metric = 'l2', approximate = true)")
+    q = vecs[42]
+    qtxt = "[" + ", ".join(f"{x:.6f}" for x in q) + "]"
+    got = [r[0] for r in s.execute(
+        f"select id from emb order by l2_distance(v, '{qtxt}') "
+        "limit 5").rows()]
+    # the true nearest (the query vector itself) must be found even by
+    # IVF (it lands in the probed centroid's bucket)
+    assert got[0] == 42
+    from oceanbase_tpu.share.vector_index import IvfFlatIndex
+
+    hit = next(v for k, v in s.catalog._ann_cache.items()
+               if k[0] == "emb")
+    assert isinstance(hit[1], IvfFlatIndex)
