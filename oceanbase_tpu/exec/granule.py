@@ -109,6 +109,68 @@ def extract_column_bounds(node) -> dict:
     return {k: tuple(v) for k, v in bounds.items()}
 
 
+def prefetch_iter(it, depth: int = 2):
+    """Overlap host-side granule production (LSM decode, CSV parse, disk
+    reads) with device compute: a daemon thread runs the producer ahead
+    into a small bounded queue (≙ the IO manager's async prefetch,
+    src/share/io/ob_io_manager.h — here one prefetcher per stream).
+
+    Exceptions in the producer re-raise at the consumer's next pull.
+    Abandoning the iterator (early break / GeneratorExit — a LIMIT that
+    stops mid-stream) stops the producer and CLOSES the wrapped
+    generator from its own thread, so provider finalizers (open LSM /
+    spill file handles) still run."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _END = object()
+    stop = threading.Event()
+
+    def put_until_stopped(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def run():
+        try:
+            for item in it:
+                if not put_until_stopped(item):
+                    break
+        except BaseException as e:  # noqa: BLE001 — ship to consumer
+            put_until_stopped(("__exc__", e))
+            return
+        finally:
+            if stop.is_set() and hasattr(it, "close"):
+                # generator close must run on the thread that executes
+                # the generator — that's this one
+                try:
+                    it.close()
+                except Exception:
+                    pass
+        put_until_stopped(_END)
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="granule-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and \
+                    item[0] == "__exc__":
+                raise item[1]
+            yield item
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
 def execute_streamed(plan: pp.PlanNode, chunk_provider,
                      chunk_rows: int = DEFAULT_CHUNK_ROWS,
                      types: dict | None = None,
@@ -167,7 +229,8 @@ def execute_streamed(plan: pp.PlanNode, chunk_provider,
     bounds = extract_column_bounds(droot)
 
     partials = []
-    for arrays, valids in chunk_provider(table, chunk_rows, bounds):
+    for arrays, valids in prefetch_iter(
+            chunk_provider(table, chunk_rows, bounds)):
         n = len(next(iter(arrays.values())))
         if n == 0:
             continue

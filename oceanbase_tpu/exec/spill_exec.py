@@ -217,7 +217,10 @@ def _scan_batches(ctx: _Ctx, subtree: pp.PlanNode, table: str):
         if probe is not None:
             out = chunk_fn({table: probe})
             ctx.record_dtypes(out)
-        for arrays, valids in provider(table, chunk_rows, bounds):
+        from oceanbase_tpu.exec.granule import prefetch_iter
+
+        for arrays, valids in prefetch_iter(
+                provider(table, chunk_rows, bounds)):
             n = len(next(iter(arrays.values()))) if arrays else 0
             if n == 0:
                 continue

@@ -76,7 +76,7 @@ def _row_bytes(rel) -> int:
 
 _DIST_OK = (pp.TableScan, pp.Filter, pp.Project, pp.GroupBy,
             pp.HashJoin, pp.SemiJoinResidual, pp.Union, pp.Compact,
-            pp.Window)
+            pp.Window, pp.ScalarAgg)
 
 
 class NotDistributable(Exception):
@@ -256,6 +256,13 @@ def choose_affinity(droot, tables):
 # ---------------------------------------------------------------------------
 
 
+def _copy_rep(out: Relation, src: Relation) -> Relation:
+    """Propagate the replicated-relation mark through shard-local ops."""
+    if getattr(src, "_px_replicated", False):
+        out._px_replicated = True
+    return out
+
+
 def _dlower(node: pp.PlanNode, tables: dict, ndev: int, axis: str,
             factor: int = 1, elide: frozenset = frozenset()) -> Relation:
     if isinstance(node, pp.TableScan):
@@ -269,23 +276,53 @@ def _dlower(node: pp.PlanNode, tables: dict, ndev: int, axis: str,
                 mask=rel.mask)
         return rel
     if isinstance(node, pp.Filter):
-        return ops.filter_rows(
-            _dlower(node.child, tables, ndev, axis, factor, elide), node.pred)
+        child = _dlower(node.child, tables, ndev, axis, factor, elide)
+        return _copy_rep(ops.filter_rows(child, node.pred), child)
     if isinstance(node, pp.Project):
-        return ops.project(
-            _dlower(node.child, tables, ndev, axis, factor, elide), node.outputs)
+        child = _dlower(node.child, tables, ndev, axis, factor, elide)
+        return _copy_rep(ops.project(child, node.outputs), child)
     if isinstance(node, pp.Compact):
-        return ops.compact(
-            _dlower(node.child, tables, ndev, axis, factor, elide), node.capacity)
+        child = _dlower(node.child, tables, ndev, axis, factor, elide)
+        return _copy_rep(ops.compact(child, node.capacity), child)
     if isinstance(node, pp.Union):
-        return ops.concat([
-            _dlower(c, tables, ndev, axis, factor, elide) for c in node.inputs])
+        kids = [_dlower(c, tables, ndev, axis, factor, elide)
+                for c in node.inputs]
+        if any(getattr(k, "_px_replicated", False) for k in kids):
+            # mixed replicated/sharded concatenation double-counts
+            raise NotDistributable("UNION over a replicated input")
+        return ops.concat(kids)
     if isinstance(node, pp.GroupBy):
         child = _dlower(node.child, tables, ndev, axis, factor, elide)
+        if getattr(child, "_px_replicated", False):
+            raise NotDistributable("GroupBy over a replicated input")
         # node.out_capacity was already scaled by scale_capacities on
         # retries; apply the factor only to the built-in default
         local_cap = (node.out_capacity if node.out_capacity is not None
                      else (1 << 16) * factor)
+        splittable = all(a.fn in ("sum", "count", "count_star", "min",
+                                  "max", "avg") for a in node.aggs)
+        if not splittable:
+            # non-decomposable aggregate (count_distinct): repartition
+            # RAW rows by group-key hash so every group lands whole on
+            # one shard, then the full aggregate runs locally — ≙ the
+            # one-phase hash groupby under a HASH exchange (the
+            # reference's fallback when partial aggregation is off)
+            from oceanbase_tpu.px.exchange import all_to_all_repartition
+
+            if node.keys:
+                per_dest = max((child.capacity + ndev - 1) // ndev * 2,
+                               1024) * factor
+                recv, ovf = all_to_all_repartition(
+                    child, list(node.keys.values()), ndev, per_dest,
+                    axis)
+                diag.push("px_exchange_overflow", ovf)
+            else:
+                recv = broadcast_gather(child, axis)
+            rel = ops.hash_groupby(recv, node.keys, node.aggs,
+                                   out_capacity=local_cap)
+            if not node.keys:
+                rel._px_replicated = True
+            return rel
         rel, ovf = dist_groupby_shard(
             child, node.keys, node.aggs, ndev=ndev,
             local_cap=local_cap, out_cap=local_cap, axis_name=axis)
@@ -304,8 +341,28 @@ def _dlower(node: pp.PlanNode, tables: dict, ndev: int, axis: str,
                             how=node.how, out_capacity=local_cap)
         return _djoin(left, right, node.left_keys, node.right_keys,
                       node.how, node.out_capacity, ndev, axis, factor)
+    if isinstance(node, pp.ScalarAgg):
+        # mid-plan scalar aggregate (a scalar-subquery fragment): local
+        # partials -> all_gather (the datahub barrier) -> final merge;
+        # every shard holds the identical global scalar, so the
+        # cross-join above it stays shard-local (≙ the PX datahub's
+        # whole-DFO aggregation, ob_dh_barrier.h).  The result is marked
+        # REPLICATED: joins must not broadcast it again.
+        child = _dlower(node.child, tables, ndev, axis, factor, elide)
+        if getattr(child, "_px_replicated", False):
+            rel = ops.scalar_agg(child, node.aggs)
+        else:
+            partial_specs, final_specs, post = split_aggs(node.aggs)
+            part = ops.scalar_agg(child, partial_specs)
+            gathered = broadcast_gather(part, axis)
+            rel = ops.scalar_agg(gathered, final_specs)
+            rel = ops.project(rel, dict(post))
+        rel._px_replicated = True
+        return rel
     if isinstance(node, pp.Window):
         child = _dlower(node.child, tables, ndev, axis, factor, elide)
+        if getattr(child, "_px_replicated", False):
+            raise NotDistributable("window over a replicated input")
         # distributed window: hash-repartition on the PARTITION BY keys
         # so each partition lands whole on one shard, then the local
         # window operator runs unchanged (≙ PKEY repartition feeding
@@ -332,6 +389,9 @@ def _dlower(node: pp.PlanNode, tables: dict, ndev: int, axis: str,
     if isinstance(node, pp.SemiJoinResidual):
         left = _dlower(node.left, tables, ndev, axis, factor, elide)
         right = _dlower(node.right, tables, ndev, axis, factor, elide)
+        if getattr(left, "_px_replicated", False):
+            # membership decisions would emit once per shard
+            raise NotDistributable("semi join over a replicated probe")
         big = right.capacity * _row_bytes(right) > BROADCAST_THRESHOLD_BYTES
         if node.left_keys and big and _keys_hash_partitionable(
                 left, right, node.left_keys, node.right_keys):
@@ -384,6 +444,30 @@ def _keys_hash_partitionable(left, right, lkeys, rkeys) -> bool:
 
 
 def _djoin(left, right, lkeys, rkeys, how, cap, ndev, axis, factor=1):
+    lrep = getattr(left, "_px_replicated", False)
+    rrep = getattr(right, "_px_replicated", False)
+    if rrep:
+        # the build side already holds the COMPLETE relation on every
+        # shard (a datahub scalar/fragment): join locally, never
+        # re-broadcast (that would emit ndev duplicate matches)
+        if how == "full":
+            # unmatched-build emission would repeat once per shard
+            raise NotDistributable("full join with a replicated build")
+        out = ops.join(left, right, lkeys, rkeys, how=how,
+                       out_capacity=cap)
+        if lrep:
+            out._px_replicated = True
+        return out
+    if lrep:
+        # replicated probe over a sharded build: each build row lives on
+        # exactly one shard, so a local inner join partitions the output
+        # correctly; outer/semi/anti would emit unmatched or membership
+        # decisions once PER SHARD
+        if how != "inner":
+            raise NotDistributable(
+                f"replicated probe side with {how} join")
+        return ops.join(left, right, lkeys, rkeys, how=how,
+                        out_capacity=cap)
     if how == "full":
         # broadcast would emit each unmatched build row once PER SHARD;
         # only hash-hash co-location keeps unmatched-build emission
@@ -469,6 +553,11 @@ def _px_compiled(plan_key, holder, mesh, axis, ndev, factor, table_names):
     def shard_body(shtables):
         with diag.collect() as entries:
             rel = _dlower(droot, shtables, ndev, axis, factor, elide)
+            if getattr(rel, "_px_replicated", False):
+                # a replicated ROOT would gather ndev duplicate copies
+                # (or ndev-overcounted partials) — run such (tiny,
+                # scalar-only) plans serially instead
+                raise NotDistributable("replicated distributed root")
             if partial_specs is not None:
                 rel = ops.scalar_agg(rel, partial_specs)
             if dist_sort is not None:

@@ -82,3 +82,40 @@ def test_streamed_from_lsm_segments(tmp_path):
     want = sum(i % 7 for i in range(500)) + 3 + 4
     assert res["s"][0] == want and res["c"][0] == 502
     db.close()
+
+
+def test_prefetch_iter_semantics():
+    """prefetch_iter: order preserved, exceptions surface, abandoning
+    the consumer closes the wrapped generator (no leaked producers)."""
+    import threading
+    import time
+
+    from oceanbase_tpu.exec.granule import prefetch_iter
+
+    assert list(prefetch_iter(iter(range(20)))) == list(range(20))
+
+    def boom():
+        yield 1
+        raise ValueError("producer failed")
+
+    it = prefetch_iter(boom())
+    assert next(it) == 1
+    try:
+        next(it)
+        raise AssertionError("exception not propagated")
+    except ValueError:
+        pass
+
+    closed = threading.Event()
+
+    def src():
+        try:
+            for i in range(1000):
+                yield i
+        finally:
+            closed.set()
+
+    g = prefetch_iter(src())
+    assert next(g) == 0
+    g.close()  # abandon early (LIMIT mid-stream)
+    assert closed.wait(5), "wrapped generator was never closed"
