@@ -346,3 +346,11 @@ class ProcedureStmt:
 class CallStmt:
     name: str
     args: list = field(default_factory=list)    # list[ir.Expr]
+
+@dataclass
+class XaStmt:
+    """XA START/END/PREPARE/COMMIT/ROLLBACK/RECOVER 'xid'
+    (≙ ObXAService SQL surface)."""
+
+    op: str
+    xid: str = ""

@@ -126,6 +126,20 @@ class Parser:
                     self.peek(1).value == "sequence":
                 return self.parse_sequence("create")
             return self.parse_create()
+        if self.peek().kind == "ident" and self.peek().value == "xa":
+            self.next()
+            t = self.next()
+            op = t.value if t.kind in ("kw", "ident") else ""
+            if op not in ("start", "begin", "end", "prepare", "commit",
+                          "rollback", "recover"):
+                raise ParseError(f"unknown XA operation {op!r}")
+            if op == "begin":
+                op = "start"
+            xid = "" if op == "recover" else self._string_lit()
+            if op == "commit" and self._accept_word("one"):
+                if not self._accept_word("phase"):
+                    raise ParseError("expected PHASE after ONE")
+            return ast.XaStmt(op, xid)
         if self.peek().kind == "ident" and self.peek().value == "call":
             self.next()
             name = self.expect_ident()

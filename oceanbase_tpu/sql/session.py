@@ -255,6 +255,8 @@ class Session:
             return self._tx_control(stmt.op)
         if isinstance(stmt, ast.SavepointStmt):
             return self._savepoint(stmt)
+        if isinstance(stmt, ast.XaStmt):
+            return self._xa(stmt)
         if isinstance(stmt, ast.ProcedureStmt):
             return self._procedure_ddl(stmt)
         if isinstance(stmt, ast.CallStmt):
@@ -1470,6 +1472,70 @@ class Session:
         return _ok()
 
     # ------------------------------------------------------------------
+    # XA transactions (externally-coordinated 2PC; ≙ ObXAService)
+    # ------------------------------------------------------------------
+    def _xa_store(self) -> dict:
+        if self.db is None:
+            raise NotImplementedError("XA needs a Database")
+        # the store lives on the TENANT's TransService: xids, tx ids,
+        # WALs, and lock tables are all tenant-scoped — a db-global
+        # store would let another tenant's service commit this tx
+        svc = self._txsvc
+        if not hasattr(svc, "xa_transactions"):
+            svc.xa_transactions = {}
+        return svc.xa_transactions
+
+    def _xa(self, stmt: ast.XaStmt) -> Result:
+        store = self._xa_store()
+        if stmt.op == "start":
+            if self._tx is not None:
+                raise RuntimeError("a transaction is already active")
+            if stmt.xid in store:
+                raise ValueError(f"XA xid {stmt.xid!r} exists")
+            self._tx = self._txsvc.begin()
+            self._tx.xid = stmt.xid
+            store[stmt.xid] = self._tx
+            return _ok()
+        if stmt.op == "recover":
+            from oceanbase_tpu.tx.service import TxState
+
+            xids = sorted(x for x, tx in store.items()
+                          if tx.state == TxState.PREPARE)
+            return Result(["xid"],
+                          {"xid": np.array(xids, dtype=object)}, {},
+                          {"xid": SqlType.string()}, rowcount=len(xids))
+        tx = store.get(stmt.xid)
+        if tx is None:
+            raise KeyError(f"unknown XA xid {stmt.xid!r}")
+        if stmt.op == "end":
+            # detach from this session; the xid keeps the tx reachable
+            if self._tx is tx:
+                self._tx = None
+            return _ok()
+        if stmt.op == "prepare":
+            self._txsvc.xa_prepare(tx)
+            if self._tx is tx:
+                # a PREPARE-state tx takes no more statements; keeping it
+                # attached would wedge every later DML in this session
+                self._tx = None
+            return _ok()
+        if self._tx is tx:
+            self._tx = None
+        from oceanbase_tpu.tx.service import TxState
+
+        if stmt.op == "commit":
+            if tx.state == TxState.ACTIVE:  # XA ... ONE PHASE path
+                self._txsvc.commit(tx)
+            else:
+                self._txsvc.xa_commit_prepared(tx)
+        else:
+            self._txsvc.xa_rollback_prepared(tx)
+        store.pop(stmt.xid, None)
+        for t in list(tx.participants):
+            self.catalog.invalidate(t)
+        return _ok()
+
+    # ------------------------------------------------------------------
     # stored procedures (interpreted PL subset; ≙ src/pl — DECLARE/SET/
     # IF/WHILE over the shared expression engine, SQL via the session)
     # ------------------------------------------------------------------
@@ -2184,6 +2250,12 @@ class Session:
     def _tx_control(self, op: str) -> Result:
         if self.db is None:
             return _ok()
+        if self._tx is not None and getattr(self._tx, "xid", None):
+            # an XA branch only ends through XA verbs (≙ XAER_RMFAIL):
+            # committing it here would strand the xid in the store
+            raise RuntimeError(
+                f"transaction is an XA branch "
+                f"({self._tx.xid!r}); use XA END/PREPARE/COMMIT")
         if op == "begin":
             if self._tx is not None:
                 self._txsvc.commit(self._tx)  # implicit commit (MySQL)

@@ -208,6 +208,67 @@ class TransService:
             self._release_locks(tx)
             return version
 
+    # ------------------------------------------------------------------
+    # XA: externally-coordinated two-phase commit (≙ ObXAService,
+    # src/storage/tx/ob_xa_service.h — the prepare/commit phases split
+    # across statements, possibly across sessions)
+    # ------------------------------------------------------------------
+    def xa_prepare(self, tx: Transaction):
+        """Phase 1: make the tx's redo + prepare records durable; the tx
+        stays in PREPARE until an explicit XA COMMIT/ROLLBACK.
+
+        LIMITATION (round 5): the PREPARE state itself is process-local —
+        replay does not yet reconstruct prepared txs after a restart, so
+        a crash between PREPARE and COMMIT implicitly rolls the branch
+        back (its redo is buffered but never applied without a commit
+        record).  The reference recovers into prepared state
+        (ob_xa_service.h); the WAL already carries the records needed."""
+        with self._lock:
+            if tx.state != TxState.ACTIVE:
+                raise TxAborted(f"tx {tx.tx_id} is {tx.state.value}")
+            records = list(tx.pending_redo)
+            for p in tx.participants.values():
+                p.state = TxState.PREPARE
+                p.prepare_version = self.gts.get_ts()
+                records.append({"op": "prepare", "tx": tx.tx_id,
+                                "table": p.table,
+                                "version": p.prepare_version})
+            self._log_batch(records)
+            tx.pending_redo = []
+            tx.state = TxState.PREPARE
+
+    def xa_commit_prepared(self, tx: Transaction) -> int:
+        """Phase 2 commit of a PREPARED tx (any session may drive it)."""
+        with self._lock:
+            if tx.state != TxState.PREPARE:
+                raise TxAborted(
+                    f"tx {tx.tx_id} is {tx.state.value}, not prepared")
+            parts = list(tx.participants.values())
+            version = max((p.prepare_version for p in parts),
+                          default=self.gts.get_ts())
+            self._log({"op": "commit", "tx": tx.tx_id,
+                       "version": version})
+            for p in parts:
+                p.tablet.commit(tx.tx_id, version, p.keys)
+                p.state = TxState.COMMIT
+            tx.state = TxState.CLEAR
+            self._live.pop(tx.tx_id, None)
+            self._release_locks(tx)
+            return version
+
+    def xa_rollback_prepared(self, tx: Transaction):
+        with self._lock:
+            if tx.state != TxState.PREPARE:
+                return self.rollback(tx)
+            # redo already reached the WAL at prepare: log the abort so
+            # replay drops the buffered records
+            self._log({"op": "abort", "tx": tx.tx_id})
+            for p in tx.participants.values():
+                p.tablet.abort(tx.tx_id, p.keys)
+            tx.state = TxState.ABORT
+            self._live.pop(tx.tx_id, None)
+            self._release_locks(tx)
+
     def rollback(self, tx: Transaction):
         with self._lock:
             if tx.state == TxState.CLEAR:

@@ -45,6 +45,22 @@ def env():
     conn.executemany("insert into t2 values (?,?,?)",
                      list(zip(t2["x"].tolist(), t2["y"].tolist(),
                               t2["w"].tolist())))
+    # MySQL functions SQLite lacks: give the oracle reference impls
+    conn.create_function("repeat", 2, lambda s_, n: None if s_ is None
+                         else str(s_) * max(int(n), 0))
+    conn.create_function(
+        "lpad", 3, lambda s_, n, p: None if s_ is None else
+        (str(s_)[:n] if len(str(s_)) >= n
+         else (str(p) * n)[: n - len(str(s_))] + str(s_)))
+    conn.create_function(
+        "concat_ws", -1,
+        lambda sep, *xs: sep.join(str(x) for x in xs if x is not None))
+    conn.create_function("isnull", 1, lambda x: 1 if x is None else 0)
+    conn.create_function("if", 3, lambda c, a, b: a if c else b)
+    conn.create_function(
+        "substring_index", 3, lambda s_, d, k: None if s_ is None else
+        (d.join(str(s_).split(d)[:k]) if k >= 0
+         else d.join(str(s_).split(d)[k:])))
     return s, conn
 
 
@@ -56,7 +72,7 @@ def _gen_query(rng) -> str:
         "not (a > 0)", "a > 0 or b = 2", "length(s) = 4",
     ]
     aggs = ["count(*)", "sum(a)", "min(f)", "max(a)", "avg(a)", "count(b)"]
-    shape = rng.integers(0, 7)
+    shape = rng.integers(0, 10)
     where = ""
     if rng.random() < 0.8:
         k = int(rng.integers(1, 3))
@@ -89,10 +105,33 @@ def _gen_query(rng) -> str:
         op = rng.choice(["union", "union all", "except", "intersect"])
         return (f"select b from t1{where} {op} "
                 f"select x from t2 order by 1")
-    # join + aggregate
-    return (f"select s, count(*) as n, sum(y) as sy from t1, t2 "
-            f"where b = x{' and ' + rng.choice(preds) if rng.random() < 0.5 else ''} "
-            f"group by s order by s")
+    if shape == 6:      # join + aggregate
+        return (f"select s, count(*) as n, sum(y) as sy from t1, t2 "
+                f"where b = x{' and ' + rng.choice(preds) if rng.random() < 0.5 else ''} "
+                f"group by s order by s")
+    if shape == 7:      # outer joins (round 4: RIGHT/FULL)
+        kind = rng.choice(["left", "right", "full outer"])
+        return (f"select a, b, x, y from t1 {kind} join t2 on b = x"
+                f"{where} order by a, b, x, y")
+    if shape == 8:      # round-4 window functions + ROWS frames
+        wf = rng.choice([
+            "lag(a) over (partition by b order by a, f)",
+            "lead(a, 2) over (partition by s order by a, f)",
+            "ntile(3) over (order by a, f)",
+            "first_value(a) over (partition by s order by a, f)",
+            "sum(a) over (partition by s order by a, f "
+            "rows between 2 preceding and current row)",
+            "min(f) over (partition by b order by a, f "
+            "rows between 1 preceding and 1 following)",
+        ])
+        return f"select a, s, {wf} as w from t1{where} order by a, f, s"
+    # round-4 string/conditional functions
+    fn = rng.choice([
+        "concat_ws('-', s, s)", "if(a > 0, s, 'neg')",
+        "instr(s, 'e')", "substring_index(s, 'e', 1)",
+        "lpad(s, 6, '*')", "repeat(s, 2)",
+    ])
+    return f"select a, {fn} as r from t1{where} order by a, s, f"
 
 
 def _normalize(rows):

@@ -1,0 +1,102 @@
+"""XA transactions: externally-coordinated 2PC across sessions
+(≙ src/storage/tx/ob_xa_service.h).
+"""
+
+import pytest
+
+from oceanbase_tpu.server import Database
+
+
+def test_xa_prepare_commit_across_sessions(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s1 = db.session()
+    s1.execute("create table t (k int primary key, v int)")
+    s1.execute("xa start 'x1'")
+    s1.execute("insert into t values (1, 10)")
+    s1.execute("xa end 'x1'")
+    s1.execute("xa prepare 'x1'")
+    # invisible until commit; visible in XA RECOVER
+    s2 = db.session()
+    assert s2.execute("select count(*) from t").rows()[0][0] == 0
+    assert s2.execute("xa recover").rows() == [("x1",)]
+    # ANOTHER session drives the commit (the XA point)
+    s2.execute("xa commit 'x1'")
+    assert s2.execute("select k, v from t").rows() == [(1, 10)]
+    assert s2.execute("xa recover").rows() == []
+    db.close()
+
+
+def test_xa_rollback_prepared(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("xa start 'r1'")
+    s.execute("insert into t values (1, 1)")
+    s.execute("xa end 'r1'")
+    s.execute("xa prepare 'r1'")
+    s.execute("xa rollback 'r1'")
+    assert s.execute("select count(*) from t").rows()[0][0] == 0
+    # the xid is free again
+    s.execute("xa start 'r1'")
+    s.execute("insert into t values (2, 2)")
+    s.execute("xa end 'r1'")
+    s.execute("xa commit 'r1'")  # one-phase (never prepared)
+    assert s.execute("select k from t").rows() == [(2,)]
+    db.close()
+
+
+def test_xa_prepared_redo_is_durable_in_wal(tmp_path):
+    """The prepare phase ships redo+prepare to the replicated log: a
+    commit record after it must replay the writes at recovery."""
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("xa start 'd1'")
+    s.execute("insert into t values (7, 70)")
+    s.execute("xa end 'd1'")
+    s.execute("xa prepare 'd1'")
+    s.execute("xa commit 'd1'")
+    db.close()
+    db2 = Database(str(tmp_path / "db"))
+    assert db2.session().execute(
+        "select k, v from t").rows() == [(7, 70)]
+    db2.close()
+
+
+def test_xa_errors(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key)")
+    with pytest.raises(KeyError):
+        s.execute("xa commit 'nope'")
+    s.execute("xa start 'a'")
+    with pytest.raises(RuntimeError):
+        s.execute("xa start 'b'")
+    s.execute("xa end 'a'")
+    s.execute("xa rollback 'a'")
+    db.close()
+
+
+def test_xa_guards(tmp_path):
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key)")
+    # plain COMMIT inside an XA branch is rejected (XAER_RMFAIL analog)
+    s.execute("xa start 'g1'")
+    s.execute("insert into t values (1)")
+    with pytest.raises(RuntimeError):
+        s.execute("commit")
+    # the session is NOT wedged after XA PREPARE (tx detaches)
+    s.execute("xa end 'g1'")
+    s.execute("xa prepare 'g1'")
+    s.execute("insert into t values (99)")  # autocommit works again
+    s.execute("xa commit 'g1'")
+    rows = s.execute("select k from t order by k").rows()
+    assert rows == [(1,), (99,)]
+    # ONE PHASE syntax parses
+    s.execute("xa start 'g2'")
+    s.execute("insert into t values (2)")
+    s.execute("xa end 'g2'")
+    s.execute("xa commit 'g2' one phase")
+    assert s.execute("select count(*) from t").rows()[0][0] == 3
+    db.close()
