@@ -23,9 +23,14 @@ from jax.sharding import PartitionSpec as P
 
 from oceanbase_tpu.exec.ops import AggSpec, hash_groupby
 from oceanbase_tpu.expr import ir
+import numpy as np
+
+from oceanbase_tpu.expr.compile import eval_expr
 from oceanbase_tpu.px.exchange import (
     PX_AXIS,
     all_to_all_repartition,
+    broadcast_gather,
+    exchange_by_dest,
     shard_relation,
     unshard_relation,
 )
@@ -135,6 +140,125 @@ def dist_groupby(
             f"increase local_cap"
         )
     return unshard_relation(out)
+
+
+_HOT_SENTINEL = np.iinfo(np.int64).max
+
+
+def _global_hot_keys(rel: Relation, keys: Sequence[ir.Expr],
+                     n_hot: int, axis_name: str):
+    """Top-``n_hot`` globally most frequent join-key values across the
+    mesh (≙ the HYBRID_HASH skew sampler feeding
+    ObSliceIdxCalc::HYBRID_HASH_*, src/sql/engine/px/ob_slice_calc.h).
+
+    Per shard: sort keys, run-length count, local top-k; all_gather the
+    candidates; re-merge and re-top-k.  Static shapes throughout.
+    -> (int64[<=n_hot] hot values (_HOT_SENTINEL-padded), combined key
+    per row, live mask) — key/mask returned so callers don't recompute
+    the combined key for classification."""
+    from oceanbase_tpu.exec.ops import _combined_key
+
+    cols = [eval_expr(e, rel) for e in keys]
+    k, _ = _combined_key(cols)
+    m = rel.mask_or_true()
+    n = rel.capacity
+
+    def topk_counts(vals, cnts, k_out):
+        # merge duplicate values: sort, segment-sum counts per run
+        k_out = min(k_out, int(vals.shape[0]))  # top_k needs k <= len
+        order = jnp.argsort(vals)
+        sv = jnp.take(vals, order)
+        sc = jnp.take(cnts, order)
+        nn = sv.shape[0]
+        newv = jnp.concatenate([jnp.ones(1, jnp.bool_),
+                                sv[1:] != sv[:-1]])
+        gid = jnp.cumsum(newv.astype(jnp.int64)) - 1
+        tot = jax.ops.segment_sum(sc, gid, num_segments=nn)
+        val = jax.ops.segment_max(sv, gid, num_segments=nn)
+        tot = jnp.where(val == _HOT_SENTINEL, 0, tot)
+        top_c, top_i = jax.lax.top_k(tot, k_out)
+        return jnp.where(top_c > 0, jnp.take(val, top_i),
+                         _HOT_SENTINEL), top_c
+
+    ks = jnp.where(m, k, _HOT_SENTINEL)
+    local_v, local_c = topk_counts(ks, jnp.ones(n, jnp.int64), n_hot)
+    gv = jax.lax.all_gather(local_v, axis_name, axis=0, tiled=True)
+    gc = jax.lax.all_gather(local_c, axis_name, axis=0, tiled=True)
+    hot_v, _hot_c = topk_counts(gv, gc, n_hot)
+    return hot_v, k, m
+
+
+def dist_join_shard_hybrid(
+    left: Relation,
+    right: Relation,
+    left_keys: Sequence[ir.Expr],
+    right_keys: Sequence[ir.Expr],
+    ndev: int,
+    cap_per_dest: int,
+    out_capacity: int,
+    how: str = "inner",
+    axis_name: str = PX_AXIS,
+    probe_cap_per_dest: int | None = None,
+    n_hot: int = 8,
+):
+    """Skew-resistant HASH-HASH join (≙ HYBRID_HASH_{BROADCAST,RANDOM}):
+
+    - hot join-key values (global top-``n_hot`` of BOTH sides) are
+      exempt from the hash exchange: hot BUILD rows broadcast to every
+      shard, hot PROBE rows stay on their home shard — a dominant key
+      never funnels into one destination's static buffer;
+    - cold rows hash-repartition exactly as the plain HASH-HASH path.
+
+    Classification is by combined key value, identical on both sides, so
+    hot and cold match sets stay disjoint and the union join is exact.
+    Probe-preserving joins (left/semi/anti) remain correct: each probe
+    row lives on exactly one shard.  ``full`` must not use this path
+    (broadcast build rows would emit unmatched copies per shard).
+    """
+    from oceanbase_tpu.exec.ops import compact, concat, join
+
+    assert how != "full", "hybrid path cannot preserve a broadcast build"
+    hot_l, lk, lm = _global_hot_keys(left, left_keys, n_hot, axis_name)
+    hot_r, rk, rm = _global_hot_keys(right, right_keys, n_hot, axis_name)
+    hotset = jnp.concatenate([hot_l, hot_r])
+
+    def classify(k, m):
+        return jnp.any(k[:, None] == hotset[None, :], axis=1) & m
+
+    l_hot = classify(lk, lm)
+    r_hot = classify(rk, rm)
+
+    def hash_dest(k, m, is_hot):
+        from oceanbase_tpu.exec.ops import _mix64
+
+        h = _mix64(k.astype(jnp.uint64))
+        d = (h % jnp.uint64(ndev)).astype(jnp.int32)
+        return jnp.where(m & ~is_hot, d, ndev)  # hot/dead -> drop
+
+    l_cap = (probe_cap_per_dest if probe_cap_per_dest is not None
+             else cap_per_dest)
+    lrecv, lov = exchange_by_dest(left, hash_dest(lk, lm, l_hot), ndev,
+                                  l_cap, axis_name)
+    rrecv, rov = exchange_by_dest(right, hash_dest(rk, rm, r_hot), ndev,
+                                  cap_per_dest, axis_name)
+    # hot probe rows stay home; hot build rows compact + broadcast.
+    # The hot-build budget is a FRACTION of a destination bucket: hot
+    # rows span at most 2*n_hot distinct keys, and a small static buffer
+    # keeps the appended broadcast from doubling every unskewed join's
+    # build capacity (overflow feeds the session retry ladder, which
+    # scales cap_per_dest and this budget with it)
+    hot_cap = max(cap_per_dest // 8, 512)
+    local_hot_probe = left.with_mask(l_hot)
+    hot_build_local = compact(right.with_mask(r_hot), capacity=hot_cap)
+    hot_overflow = jnp.maximum(
+        jnp.sum(r_hot.astype(jnp.int64)) - hot_cap, 0)
+    hot_build = broadcast_gather(hot_build_local, axis_name)
+
+    probe_all = concat([lrecv, local_hot_probe])
+    build_all = concat([rrecv, hot_build])
+    out = join(probe_all, build_all, left_keys, right_keys, how=how,
+               out_capacity=out_capacity)
+    return out, lov + rov + hot_overflow
 
 
 def dist_join_shard(

@@ -512,7 +512,13 @@ def _djoin(left, right, lkeys, rkeys, how, cap, ndev, axis, factor=1):
     else:
         l_per_dest = per_dest
     local_cap = cap if cap is None else max(cap // ndev * 2, 1024)
-    out, ovf = dist_join_shard(
+    # HYBRID_HASH: hot keys bypass the hash exchange (hot build rows
+    # broadcast, hot probe rows stay home) so a skewed key can't funnel
+    # into one destination's static buffer (≙ ObSliceIdxCalc
+    # HYBRID_HASH_{BROADCAST,RANDOM}); FULL keeps the plain path
+    from oceanbase_tpu.px.dist_ops import dist_join_shard_hybrid
+
+    out, ovf = dist_join_shard_hybrid(
         left, right, lkeys, rkeys, ndev=ndev, cap_per_dest=per_dest,
         probe_cap_per_dest=l_per_dest,
         out_capacity=local_cap, how=how, axis_name=axis)
