@@ -130,6 +130,14 @@ def execute_spilled(plan: pp.PlanNode, providers: dict, spill_dir: str,
     if not big:
         raise NotDistributable("no over-budget table to stream")
 
+    def _split(aggs):
+        # the spill tier's public contract is NotDistributable for every
+        # unsupported shape — including non-splittable aggregates
+        try:
+            return split_aggs(aggs)
+        except NotImplementedError as e:
+            raise NotDistributable(str(e)) from None
+
     with TempFileStore(spill_dir) as store:
         ctx = _Ctx(store, budget_rows, chunk_rows, providers,
                    device_tables or {}, types_by_table or {}, big)
@@ -137,7 +145,7 @@ def execute_spilled(plan: pp.PlanNode, providers: dict, spill_dir: str,
             batches = _stream_subtree(ctx, inner)
             if group_node is not None:
                 partial_specs, final_specs, post = \
-                    split_aggs(group_node.aggs)
+                    _split(group_node.aggs)
                 keys = group_node.keys
                 batches = _partial_groupby_batches(ctx, batches, keys,
                                                    partial_specs)
@@ -146,7 +154,7 @@ def execute_spilled(plan: pp.PlanNode, providers: dict, spill_dir: str,
                 ctx.stats.kind = "groupby"
             elif scalar_agg is not None:
                 partial_specs, final_specs, post = \
-                    split_aggs(scalar_agg.aggs)
+                    _split(scalar_agg.aggs)
                 batches = _partial_scalar_batches(ctx, batches,
                                                   partial_specs)
                 batches = _scalar_final(ctx, batches, final_specs, post)

@@ -113,6 +113,11 @@ class AshSampler:
         with self._lock:
             self._sessions.pop(session_id, None)
 
+    def sessions(self):
+        """Snapshot of registered session states (SHOW PROCESSLIST)."""
+        with self._lock:
+            return {sid: dict(st) for sid, st in self._sessions.items()}
+
     def sample_once(self):
         now = time.time()
         with self._lock:

@@ -252,7 +252,8 @@ class UserStmt:
 
 @dataclass
 class ShowStmt:
-    what: str    # variables | parameters
+    what: str    # variables | parameters | index | processlist
+    table: str = ""
 
 
 @dataclass

@@ -216,6 +216,13 @@ class Parser:
             if self.accept_kw("create"):
                 self.expect_kw("table")
                 return ast.ShowCreateStmt(self.expect_ident())
+            if self.accept_kw("index") or self._accept_word("indexes"):
+                if not (self._accept_word("from")
+                        or self.accept_kw("on")):
+                    raise ParseError("expected FROM after SHOW INDEX")
+                return ast.ShowStmt("index", self.expect_ident())
+            if self._accept_word("processlist"):
+                return ast.ShowStmt("processlist")
             self.expect_kw("tables")
             return ast.ShowTablesStmt()
         if self.at_kw("describe"):

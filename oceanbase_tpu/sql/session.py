@@ -344,6 +344,47 @@ class Session:
         if isinstance(stmt, ast.LockTableStmt):
             return self._lock_table(stmt)
         if isinstance(stmt, ast.ShowStmt):
+            if stmt.what == "index":
+                td = self.catalog.table_def(stmt.table)
+                names, cols, uniq, kinds = [], [], [], []
+                if td.primary_key:
+                    names.append("PRIMARY")
+                    cols.append(",".join(td.primary_key))
+                    uniq.append(1)
+                    kinds.append("primary")
+                for ix in td.indexes:
+                    names.append(ix.name)
+                    cols.append(",".join(ix.columns))
+                    uniq.append(1 if ix.unique else 0)
+                    kinds.append("unique" if ix.unique else "normal")
+                for nm, spec in td.aux_indexes.items():
+                    names.append(nm)
+                    cols.append(spec["column"])
+                    uniq.append(0)
+                    kinds.append(spec["kind"])
+                return Result(
+                    ["key_name", "columns", "unique", "index_type"],
+                    {"key_name": np.array(names, dtype=object),
+                     "columns": np.array(cols, dtype=object),
+                     "unique": np.array(uniq, dtype=np.int64),
+                     "index_type": np.array(kinds, dtype=object)},
+                    {}, {}, rowcount=len(names))
+            if stmt.what == "processlist":
+                rows = []
+                if self.db is not None and \
+                        getattr(self.db, "ash", None) is not None:
+                    for sid, st in self.db.ash.sessions().items():
+                        rows.append((sid, st.get("state", "idle"),
+                                     st.get("sql", "")[:120]))
+                rows.sort()
+                return Result(
+                    ["id", "state", "info"],
+                    {"id": np.array([r[0] for r in rows], np.int64),
+                     "state": np.array([r[1] for r in rows],
+                                       dtype=object),
+                     "info": np.array([r[2] for r in rows],
+                                      dtype=object)},
+                    {}, {}, rowcount=len(rows))
             if stmt.what == "variables":
                 names = sorted(self.variables)
                 return Result(
@@ -1123,7 +1164,9 @@ class Session:
                 plan, providers, sdir,
                 int(self.db.config["sql_work_area_rows"]),
                 device_tables, types_by_table, big)
-        except NotDistributable:
+        except (NotDistributable, NotImplementedError):
+            # unsupported shape OR a non-splittable aggregate
+            # (count_distinct) — fall back to the in-memory engine
             return None
         self._last_spill = stats
         self.db.workarea_history.append({

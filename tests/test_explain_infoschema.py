@@ -36,3 +36,22 @@ def test_information_schema(tmp_path):
     assert rows[0] == ("k", "INT", "PRI")
     assert rows[1][0] == "v" and "DECIMAL" in rows[1][1]
     db.close()
+
+
+def test_show_index_and_processlist(tmp_path):
+    from oceanbase_tpu.server import Database
+
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key, v int, "
+              "e vector(3))")
+    s.execute("create unique index iv on t (v)")
+    s.execute("create vector index ie on t (e)")
+    rows = s.execute("show index from t").rows()
+    by_name = {r[0]: r for r in rows}
+    assert by_name["PRIMARY"][3] == "primary"
+    assert by_name["iv"][2] == 1 and by_name["iv"][3] == "unique"
+    assert by_name["ie"][3] == "vector"
+    rows = s.execute("show processlist").rows()
+    assert any("show processlist" in (r[2] or "") for r in rows)
+    db.close()

@@ -160,3 +160,17 @@ def test_spill_disabled_falls_back(tmp_path):
     assert r.rows()[0][0] == 8192
     assert s._last_spill is None
     db.close()
+
+
+def test_distinct_over_budget_spills(tmp_path):
+    """SELECT DISTINCT streams through the spill group-by; COUNT(DISTINCT)
+    (non-splittable) falls back to the in-memory engine instead of
+    leaking NotImplementedError (VERDICT r3 #7 tail)."""
+    db, s = _mk(tmp_path)
+    _k, _v, g = _load_big(s)
+    r = s.execute("select distinct g from t order by g")
+    assert len(r.rows()) == len(set(g.tolist()))
+    assert s._last_spill is not None and "groupby" in s._last_spill.kind
+    r = s.execute("select count(distinct g) from t")
+    assert r.rows()[0][0] == len(set(g.tolist()))
+    db.close()
