@@ -113,7 +113,40 @@ class Catalog:
         # "delimiter", "skip", "cache": (mtime, Relation)|None}
         # (≙ src/share/external_table — files scanned at query time)
         self._externals: dict[str, dict] = {}
+        # views: name -> {"sql": body text, "cols": [alias...]|[]}
+        # (≙ __all_view view_definition; expanded at bind time)
+        self._views: dict[str, dict] = {}
         self.schema_version = 1
+
+    # -- views ------------------------------------------------------------
+    def create_view(self, name: str, sql: str, cols=None,
+                    or_replace: bool = False):
+        with self._lock:
+            if self.has_table(name) or name in self._externals:
+                raise ValueError(f"table {name} already exists")
+            if name in self._views and not or_replace:
+                raise ValueError(f"view {name} already exists")
+            self._views[name] = {"sql": sql, "cols": list(cols or [])}
+            self.schema_version += 1
+
+    def drop_view(self, name: str) -> bool:
+        with self._lock:
+            if self._views.pop(name, None) is None:
+                return False
+            self.schema_version += 1
+            return True
+
+    def view_def(self, name: str):
+        with self._lock:
+            return self._views.get(name)
+
+    def view_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._views)
+
+    def drop_transient(self, name: str):
+        with self._lock:
+            self._transients.pop(name, None)
 
     # -- external tables --------------------------------------------------
     def register_external(self, tdef: TableDef, location: str,
@@ -170,7 +203,8 @@ class Catalog:
             e["tdef"].row_count = rel.capacity
         return rel
 
-    def register_transient(self, name: str, arrays, types=None):
+    def register_transient(self, name: str, arrays, types=None,
+                           valids=None):
         import jax.numpy as jnp
 
         from oceanbase_tpu.vector import Relation, from_numpy
@@ -187,7 +221,7 @@ class Catalog:
                            mask=jnp.zeros(1, dtype=jnp.bool_))
             row_count = 0
         else:
-            rel = from_numpy(arrays, types=types)
+            rel = from_numpy(arrays, types=types, valids=valids or None)
             row_count = rel.capacity
         cols = [ColumnDef(c, rel.columns[c].dtype) for c in arrays]
         tdef = TableDef(name, cols, row_count=max(row_count, 1))
@@ -196,6 +230,8 @@ class Catalog:
 
     # -- DDL -------------------------------------------------------------
     def create_table(self, tdef: TableDef, if_not_exists: bool = False):
+        if self.view_def(tdef.name) is not None:
+            raise ValueError(f"view {tdef.name} already exists")
         with self._lock:
             if tdef.name in self._defs or tdef.name in self._externals:
                 if if_not_exists:

@@ -31,7 +31,8 @@ from oceanbase_tpu.palf.cluster import NoQuorum, NotLeader
 from oceanbase_tpu.palf.netcluster import NetPalf
 from oceanbase_tpu.share.location import LocationCache
 
-_DDL_KINDS = {"create_table", "drop_table", "truncate", "alter_add",
+_DDL_KINDS = {"create_view", "drop_view",
+              "create_table", "drop_table", "truncate", "alter_add",
               "alter_drop", "create_index", "drop_index", "aux_index",
               "drop_aux_index"}
 _WRITE_PREFIXES = ("insert", "update", "delete", "replace", "create",

@@ -107,6 +107,12 @@ class SelectStmt:
     post_order_by: list = field(default_factory=list)
     post_limit: Optional[int] = None
     post_offset: int = 0
+    # when this SelectStmt is a CTE body: explicit column aliases from
+    # `WITH name (a, b) AS (...)`, and whether the WITH was RECURSIVE
+    # (≙ src/sql/engine/recursive_cte — the session materializes
+    # self-referencing CTEs to a fixpoint before binding)
+    cte_cols: list = field(default_factory=list)
+    is_recursive: bool = False
 
 
 @dataclass
@@ -133,6 +139,25 @@ class CreateTableStmt:
 
 @dataclass
 class DropTableStmt:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreateViewStmt:
+    """CREATE [OR REPLACE] VIEW name [(cols)] AS select
+    (≙ src/sql/resolver/ddl/ob_create_view_resolver.cpp — stored as SQL
+    text in the catalog, expanded at bind time like a derived table)."""
+
+    name: str
+    columns: list            # explicit output column names, or []
+    select: "SelectStmt"     # parsed body (validation; binding re-parses)
+    sql_text: str            # the AS ... text, persisted
+    or_replace: bool = False
+
+
+@dataclass
+class DropViewStmt:
     name: str
     if_exists: bool = False
 

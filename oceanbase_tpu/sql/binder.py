@@ -139,6 +139,9 @@ class Binder:
         # (nextval, eagerly-executed scalar subqueries): such plans must
         # never be cached — re-binding is what re-evaluates them
         self.folded_volatile = False
+        # cycle guards: CTE / view names currently being expanded
+        self._cte_stack: set[str] = set()
+        self._view_stack: set[str] = set()
 
     # ------------------------------------------------------------------
     def bind_select(self, stmt: ast.SelectStmt,
@@ -390,12 +393,36 @@ class Binder:
         name = tref.name
         if name in self.ctes:
             sub = self.ctes[name]
-            sub_plan, sub_outs, sub_est = self.bind_select(sub, outer=None)
+            if name in self._cte_stack:
+                # a self-reference surviving to here means the session's
+                # recursive-CTE materializer didn't handle it (nested /
+                # non-top-level WITH RECURSIVE)
+                raise BindError(
+                    f"recursive reference to CTE {name!r} is only "
+                    "supported in a top-level WITH RECURSIVE")
+            self._cte_stack.add(name)
+            try:
+                sub_plan, sub_outs, sub_est = self.bind_select(
+                    sub, outer=None)
+            finally:
+                self._cte_stack.discard(name)
+            aliases = getattr(sub, "cte_cols", None)
+            if aliases:
+                if len(aliases) != len(sub_outs):
+                    raise BindError(
+                        f"CTE {name} declares {len(aliases)} columns but "
+                        f"its body produces {len(sub_outs)}")
+                sub_outs = [(cid, a) for (cid, _), a in
+                            zip(sub_outs, aliases)]
             cols = {}
             for cid, oname in sub_outs:
                 scope.add(oname, cid, alias=tref.alias or name)
                 cols[oname] = cid
             qb.fragments.append(Fragment(sub_plan, cols, max(sub_est, 1)))
+            return
+        vdef = self.catalog.view_def(name)
+        if vdef is not None:
+            self._bind_view(name, vdef, tref, qb, scope)
             return
         tdef = self.catalog.table_def(name)
         alias = tref.alias or name
@@ -422,6 +449,51 @@ class Binder:
             cols, max(tdef.row_count, 1), frozenset(unique), ndv=ndv,
             hist=hist,
         ))
+
+    def _bind_view(self, name: str, vdef: dict, tref, qb, scope):
+        """Expand a view body inline as a derived table (≙ view merge /
+        ObCreateViewResolver storing text, the transformer expanding it).
+        The body binds in a CLEAN CTE environment — a view must not see
+        the referencing query's CTEs — and re-parses per schema version
+        (cached on the vdef dict)."""
+        if name in self._view_stack:
+            raise BindError(f"view {name} recursively references itself")
+        # parsed-body cache lives on the catalog (NOT on vdef: that dict
+        # round-trips through the JSON manifest), keyed by schema version
+        cache = getattr(self.catalog, "_view_ast_cache", None)
+        if cache is None:
+            cache = self.catalog._view_ast_cache = {}
+        cached = cache.get(name)
+        if cached is None or cached[0] != self.catalog.schema_version:
+            from oceanbase_tpu.sql.parser import Parser
+
+            body = Parser(vdef["sql"]).parse()
+            if not isinstance(body, ast.SelectStmt):
+                raise BindError(f"view {name} body is not a SELECT")
+            cached = (self.catalog.schema_version, body)
+            cache[name] = cached
+        cached = cached[1]
+        self._view_stack.add(name)
+        saved_ctes = self.ctes
+        self.ctes = {}
+        try:
+            sub_plan, sub_outs, sub_est = self.bind_select(
+                cached, outer=None)
+        finally:
+            self.ctes = saved_ctes
+            self._view_stack.discard(name)
+        aliases = vdef.get("cols") or []
+        if aliases:
+            if len(aliases) != len(sub_outs):
+                raise BindError(
+                    f"view {name} declares {len(aliases)} columns but its "
+                    f"body produces {len(sub_outs)}")
+            sub_outs = [(cid, a) for (cid, _), a in zip(sub_outs, aliases)]
+        cols = {}
+        for cid, oname in sub_outs:
+            scope.add(oname, cid, alias=tref.alias or name)
+            cols[oname] = cid
+        qb.fragments.append(Fragment(sub_plan, cols, max(sub_est, 1)))
 
     def _bind_join(self, j: ast.JoinRef, qb: QueryBlock, scope: Scope):
         if j.kind in ("inner", "cross"):

@@ -262,16 +262,21 @@ class Parser:
     def parse_select(self) -> ast.SelectStmt:
         ctes = []
         if self.accept_kw("with"):
-            if self.accept_kw("recursive"):
-                # ≙ src/sql/engine/recursive_cte — not implemented here;
-                # fail loudly instead of mis-resolving the recursive ref
-                raise ParseError("WITH RECURSIVE is not supported")
+            recursive = bool(self.accept_kw("recursive"))
             while True:
                 name = self.expect_ident()
+                cols = []
+                if self.accept_op("("):
+                    cols.append(self.expect_ident())
+                    while self.accept_op(","):
+                        cols.append(self.expect_ident())
+                    self.expect_op(")")
                 self.expect_kw("as")
                 self.expect_op("(")
                 sub = self.parse_select()
                 self.expect_op(")")
+                sub.cte_cols = cols
+                sub.is_recursive = recursive
                 ctes.append((name, sub))
                 if not self.accept_op(","):
                     break
@@ -1240,6 +1245,19 @@ class Parser:
                 self.peek().value == "procedure":
             self.next()
             return self.parse_create_procedure()
+        or_replace = False
+        if self.at_kw("or"):
+            self.next()
+            if not (self.peek().kind == "ident" and
+                    self.peek().value == "replace"):
+                raise ParseError("expected REPLACE after CREATE OR")
+            self.next()
+            or_replace = True
+        if self.peek().kind == "ident" and self.peek().value == "view":
+            self.next()
+            return self.parse_create_view(or_replace)
+        if or_replace:
+            raise ParseError("expected VIEW after CREATE OR REPLACE")
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):
@@ -1352,8 +1370,33 @@ class Parser:
         stmt.indexes = inline_indexes
         return stmt
 
+    def parse_create_view(self, or_replace: bool):
+        """CREATE [OR REPLACE] VIEW name [(cols)] AS select — the body is
+        kept as SQL text (≙ __all_view storing view_definition) so the
+        binder re-parses it under the schema version current at use."""
+        name = self.expect_ident()
+        cols = []
+        if self.accept_op("("):
+            cols.append(self.expect_ident())
+            while self.accept_op(","):
+                cols.append(self.expect_ident())
+            self.expect_op(")")
+        self.expect_kw("as")
+        body_start = self.peek().pos
+        sel = self.parse_select()
+        text = self.sql[body_start:].strip().rstrip(";").strip()
+        return ast.CreateViewStmt(name, cols, sel, text,
+                                  or_replace=or_replace)
+
     def parse_drop(self):
         self.expect_kw("drop")
+        if self.peek().kind == "ident" and self.peek().value == "view":
+            self.next()
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.DropViewStmt(self.expect_ident(), if_exists)
         if self.accept_kw("index"):
             # DROP INDEX [IF EXISTS] name ON table
             if_exists = False

@@ -199,6 +199,11 @@ class StorageEngine:
             if ts is not None:
                 ts.tdef.indexes = [ix for ix in ts.tdef.indexes
                                    if ix.name != op["name"]]
+        elif kind == "create_view":
+            self.meta.setdefault("views", {})[op["name"]] = {
+                "sql": op["sql"], "cols": op.get("cols", [])}
+        elif kind == "drop_view":
+            self.meta.get("views", {}).pop(op["name"], None)
         elif kind == "aux_index":
             ts = self.tables.get(op["table"])
             if ts is not None:
@@ -775,6 +780,36 @@ class StorageCatalog(Catalog):
     def register_external(self, tdef, location, **kw):
         super().register_external(tdef, location, **kw)
         self._persist_externals()
+
+    # -- views persist in engine meta (slog + manifest) and replicate
+    # through the DDL log stream like other logical DDL -----------------
+    def create_view(self, name, sql, cols=None, or_replace=False):
+        with self._lock:
+            if self.has_table(name) or name in self._externals:
+                raise ValueError(f"table {name} already exists")
+            views = self.engine.meta.setdefault("views", {})
+            if name in views and not or_replace:
+                raise ValueError(f"view {name} already exists")
+            views[name] = {"sql": sql, "cols": list(cols or [])}
+            self.schema_version += 1
+        self.engine._log_meta({"op": "create_view", "name": name,
+                               "sql": sql, "cols": list(cols or [])})
+
+    def drop_view(self, name) -> bool:
+        with self._lock:
+            if self.engine.meta.get("views", {}).pop(name, None) is None:
+                return False
+            self.schema_version += 1
+        self.engine._log_meta({"op": "drop_view", "name": name})
+        return True
+
+    def view_def(self, name):
+        # read through to engine meta: replicated DDL applied by the
+        # follower's replay service becomes visible without invalidation
+        return self.engine.meta.get("views", {}).get(name)
+
+    def view_names(self):
+        return sorted(self.engine.meta.get("views", {}))
 
     def drop_external(self, name: str) -> bool:
         out = super().drop_external(name)
