@@ -158,10 +158,14 @@ class Catalog:
                 if if_not_exists:
                     return
                 raise ValueError(f"external table {tdef.name} exists")
-        if self.has_table(tdef.name):
-            # never shadow an existing base/transient table
-            raise ValueError(f"table {tdef.name} already exists")
-        with self._lock:
+            # collision checks inside ONE locked section (no
+            # check-then-act window against concurrent DDL); has_table()
+            # stays virtual — StorageCatalog covers WAL-applied engine
+            # tables the base maps don't know about
+            if self.has_table(tdef.name):
+                raise ValueError(f"table {tdef.name} already exists")
+            if self.view_def(tdef.name) is not None:
+                raise ValueError(f"view {tdef.name} already exists")
             self._externals[tdef.name] = {
                 "tdef": tdef, "location": location, "format": fmt,
                 "delimiter": delimiter, "skip": skip_lines,
@@ -205,20 +209,24 @@ class Catalog:
 
     def register_transient(self, name: str, arrays, types=None,
                            valids=None):
-        import jax.numpy as jnp
-
-        from oceanbase_tpu.vector import Relation, from_numpy
+        from oceanbase_tpu.vector import empty_relation, from_numpy
 
         n = len(next(iter(arrays.values()))) if arrays else 0
         if n == 0:
             # static shapes need capacity >= 1: one all-dead row
-            arrays = {k: (np.array([""], dtype=object)
-                          if np.asarray(v).dtype.kind in "OUS"
-                          else np.zeros(1, dtype=np.asarray(v).dtype))
-                      for k, v in arrays.items()}
-            rel = from_numpy(arrays, types=types)
-            rel = Relation(columns=rel.columns,
-                           mask=jnp.zeros(1, dtype=jnp.bool_))
+            def infer(v):
+                kind = np.asarray(v).dtype.kind
+                if kind in "OUS":
+                    return SqlType.string()
+                if kind == "f":
+                    return SqlType.double()
+                if kind == "b":
+                    return SqlType.bool_()
+                return SqlType.int_()
+
+            col_types = {k: (types or {}).get(k) or infer(v)
+                         for k, v in arrays.items()}
+            rel = empty_relation(col_types)
             row_count = 0
         else:
             rel = from_numpy(arrays, types=types, valids=valids or None)
@@ -226,13 +234,22 @@ class Catalog:
         cols = [ColumnDef(c, rel.columns[c].dtype) for c in arrays]
         tdef = TableDef(name, cols, row_count=max(row_count, 1))
         with self._lock:
+            # symmetric to register_external: a transient must not
+            # shadow a view (re-registering an existing transient is the
+            # normal per-statement gv$ refresh and stays allowed)
+            if self.view_def(name) is not None:
+                raise ValueError(f"view {name} already exists")
             self._transients[name] = (tdef, rel)
 
     # -- DDL -------------------------------------------------------------
     def create_table(self, tdef: TableDef, if_not_exists: bool = False):
-        if self.view_def(tdef.name) is not None:
-            raise ValueError(f"view {tdef.name} already exists")
         with self._lock:
+            # view-collision check INSIDE the locked section: a
+            # concurrent CREATE VIEW between check and insert must not
+            # leave a table shadowing a view (create_view holds the same
+            # lock, so check+insert is atomic against it)
+            if self.view_def(tdef.name) is not None:
+                raise ValueError(f"view {tdef.name} already exists")
             if tdef.name in self._defs or tdef.name in self._externals:
                 if if_not_exists:
                     return

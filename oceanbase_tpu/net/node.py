@@ -49,7 +49,13 @@ class NodeDatabase:
     def __init__(self, node, root):
         import itertools
 
-        from oceanbase_tpu.server.monitor import PlanMonitor, SqlAudit
+        from oceanbase_tpu.px.dtl import DtlMetrics
+        from oceanbase_tpu.server.monitor import (
+            PlanMonitor,
+            SqlAudit,
+            WaitEvents,
+        )
+        from oceanbase_tpu.server.virtual_tables import VirtualTables
 
         self._node = node
         self.root = root
@@ -58,7 +64,11 @@ class NodeDatabase:
         self.workarea_history: list = []
         self.plan_monitor = PlanMonitor()
         self.audit = SqlAudit(int(self.config["sql_audit_queue_size"]))
+        self.wait_events = WaitEvents()
         self.ash = None
+        self.dtl_metrics = DtlMetrics()
+        self.dtl = None  # DtlExchange, installed by NodeServer
+        self.virtual_tables = VirtualTables(self)
         self._session_ids = itertools.count(1)
 
     @property
@@ -92,6 +102,7 @@ class NodeServer:
         from oceanbase_tpu.server.tenant import Tenant
 
         self.node_id = node_id
+        self.peer_addrs = dict(peers)
         self.peers = {pid: RpcClient(h, p)
                       for pid, (h, p) in peers.items()}
         self._apply_lock = threading.RLock()
@@ -111,12 +122,17 @@ class NodeServer:
         # in _apply_entry; physical segment ops stay node-local)
         self.engine.ddl_wal_cb = self._on_local_ddl
         self.db = NodeDatabase(self, root)
+        from oceanbase_tpu.px.dtl import DtlExchange
+
+        self.db.dtl = DtlExchange(self, self.db.dtl_metrics)
         self.location = LocationCache(node_id, self.peers,
                                       self.palf._on_state)
 
         handlers = {
             "ping": lambda: "pong",
             "das.scan": self._h_scan,
+            "das.pull": self._h_pull,
+            "dtl.execute": self._h_dtl_execute,
             "sql.execute": self._h_execute,
             "node.state": self._h_state,
             **self.palf.handlers(),
@@ -189,6 +205,43 @@ class NodeServer:
                                c.dtype.scale or 0]
                       for c in ts.tdef.columns},
         }
+
+    def _h_pull(self, table: str, node_id: int | None = None):
+        """Pull a table's full snapshot from a peer via the legacy
+        das.scan paging (the path DTL pushdown replaces) and report its
+        wire cost — the pushdown-vs-pull comparison surface used by
+        scripts/dtl_bench.py; the pull is recorded as a mode='pull' row
+        in gv$px_exchange by fetch_remote_table."""
+        stats: dict = {}
+        arrays, _valids, _types, snap = self.fetch_remote_table(
+            table, node_id=node_id, stats=stats)
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        return {"rows": n, "snapshot": snap,
+                "bytes": stats.get("bytes", 0), "node": self.node_id}
+
+    def _h_dtl_execute(self, plan: dict, table: str, snapshot: int,
+                       part: int = 0, nparts: int = 1,
+                       applied_lsn: int = 0):
+        """Execute one DTL partial-plan slice against the local replica
+        (≙ the SQC running its DFO over local tablets and streaming
+        exchange rows back; px/dtl.py holds the plan wire codec).
+
+        ``applied_lsn`` is the coordinator's WAL apply point when it
+        chose the snapshot: a replica behind it may be missing rows
+        visible at ``snapshot``, so it refuses and the coordinator runs
+        the slice on its own replica instead; a replica AHEAD is fine —
+        the MVCC snapshot filter hides any newer versions."""
+        from oceanbase_tpu.px import dtl
+
+        ts = self.engine.tables.get(table)
+        if ts is None:
+            raise KeyError(f"table {table} not on node {self.node_id}")
+        if self.palf.replica.applied_lsn < int(applied_lsn):
+            raise dtl.DtlLagging(
+                f"node {self.node_id} applied lsn "
+                f"{self.palf.replica.applied_lsn} < {applied_lsn}")
+        return dtl.execute_fragment(ts, plan, int(snapshot), int(part),
+                                    int(nparts))
 
     def _h_execute(self, sql: str, consistency: str = "strong",
                    session_id: int = 0, forwarded: bool = False):
@@ -272,17 +325,24 @@ class NodeServer:
     # remote-relation fetch (DAS client side)
     # ------------------------------------------------------------------
     def fetch_remote_table(self, table: str, node_id: int | None = None,
-                           snapshot: int | None = None):
+                           snapshot: int | None = None,
+                           stats: dict | None = None):
         """Stream a table's snapshot from its home node in chunks
-        -> (arrays, valids, types, snapshot)."""
+        -> (arrays, valids, types, snapshot).  ``stats`` (optional dict)
+        receives the exact wire cost: {"bytes", "rows"}."""
+        import time as _time
+
         if node_id is None:
             node_id = self.location.home_of(table)
         cli = self.peers[node_id]
         chunks = []
-        snap, off = snapshot, 0
+        snap, off, nbytes = snapshot, 0, 0
+        t0 = _time.time()
         while True:
-            r = cli.call("das.scan", table=table, snapshot=snap,
-                         offset=off, limit=SCAN_CHUNK_ROWS)
+            r, sent, recv = cli.call_with_size(
+                "das.scan", table=table, snapshot=snap,
+                offset=off, limit=SCAN_CHUNK_ROWS)
+            nbytes += sent + recv
             snap = r["snapshot"]
             chunks.append(r)
             off += SCAN_CHUNK_ROWS
@@ -293,6 +353,18 @@ class NodeServer:
             arrays[k] = np.concatenate([c["arrays"][k] for c in chunks])
         for k in chunks[0].get("valids", {}):
             valids[k] = np.concatenate([c["valids"][k] for c in chunks])
+        if stats is not None:
+            stats["bytes"] = nbytes
+            stats["rows"] = chunks[0]["total"]
+        metrics = getattr(self.db, "dtl_metrics", None)
+        if metrics is not None:
+            from oceanbase_tpu.px.dtl import DtlRecord
+
+            metrics.record(DtlRecord(
+                ts=t0, table=table, mode="pull", parts=1,
+                pushdown_hit=False, bytes_shipped=nbytes,
+                rows_shipped=chunks[0]["total"],
+                elapsed_s=_time.time() - t0))
         return arrays, valids, chunks[0]["types"], snap
 
     # ------------------------------------------------------------------

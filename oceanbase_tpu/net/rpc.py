@@ -131,6 +131,11 @@ class RpcClient:
         self._sock = s
 
     def call(self, method: str, **params):
+        return self.call_with_size(method, **params)[0]
+
+    def call_with_size(self, method: str, **params):
+        """Like call(), but also returns the wire cost:
+        -> (result, sent_bytes, recv_bytes)."""
         with self._lock:
             req = encode_msg({"method": method, "params": params,
                               "rid": next(self._rid)})
@@ -157,11 +162,13 @@ class RpcClient:
             if frame is None:
                 self.close()
                 raise ConnectionError(f"peer {self.addr} closed")
+            sent = len(req) + 4
+            recv = len(frame) + 4
             resp = decode_msg(frame)
             if not resp.get("ok"):
                 raise RpcError(resp.get("error_kind", "Remote"),
                                resp.get("error", ""))
-            return resp.get("result")
+            return resp.get("result"), sent, recv
 
     def ping(self) -> bool:
         try:

@@ -90,6 +90,13 @@ DEF("pdml_min_rows", 8192, "int",
     "fan the write phase out over tenant workers (≙ enable_parallel_dml "
     "+ the PDML DFO split, src/sql/engine/pdml)", _pos)
 DEF("pdml_dop", 4, "int", "parallel-DML worker count", _pos)
+DEF("enable_dtl_pushdown", True, "bool",
+    "ship qualifying single-table partial plans to cluster nodes over "
+    "the DTL exchange instead of scanning everything on the "
+    "coordinator (≙ PX DFO scheduling onto data-owning servers)")
+DEF("dtl_min_rows", 4096, "int",
+    "minimum estimated base-table rows before a plan is considered for "
+    "DTL pushdown (below it, per-node RPC overhead dominates)", _nonneg)
 
 # storage
 DEF("memstore_limit_rows", 1_000_000, "int",

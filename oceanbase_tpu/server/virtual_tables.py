@@ -31,6 +31,7 @@ class VirtualTables:
         return {
             "gv$sql_audit": self.sql_audit,
             "gv$plan_monitor": self.plan_monitor,
+            "gv$px_exchange": self.px_exchange,
             "v$session_history": self.session_history,
             "v$parameters": self.parameters,
             "v$tenants": self.tenants,
@@ -76,8 +77,31 @@ class VirtualTables:
             "plan_elapsed_s": np.array([r[4] for r in rows], np.float64),
         }
 
+    def px_exchange(self):
+        """DTL exchange activity: plan-pushdown vs snapshot-pull events
+        with their wire cost (≙ gv$px_dtl traffic stats; px/dtl.py)."""
+        m = getattr(self.db, "dtl_metrics", None)
+        recs = m.recent(1000) if m is not None else []
+        return {
+            "ts": np.array([r.ts for r in recs], np.float64),
+            "table_name": _obj(r.table for r in recs),
+            "mode": _obj(r.mode for r in recs),
+            "parts": np.array([r.parts for r in recs], np.int64),
+            "pushdown_hit": np.array(
+                [1 if r.pushdown_hit else 0 for r in recs], np.int64),
+            "bytes_shipped": np.array([r.bytes_shipped for r in recs],
+                                      np.int64),
+            "rows_shipped": np.array([r.rows_shipped for r in recs],
+                                     np.int64),
+            "fallback_parts": np.array([r.fallback_parts for r in recs],
+                                       np.int64),
+            "elapsed_s": np.array([r.elapsed_s for r in recs],
+                                  np.float64),
+        }
+
     def session_history(self):
-        h = self.db.ash.history(10000)
+        ash = getattr(self.db, "ash", None)
+        h = ash.history(10000) if ash is not None else []
         return {
             "sample_ts": np.array([x[0] for x in h], np.float64),
             "session_id": np.array([x[1] for x in h], np.int64),
@@ -147,10 +171,20 @@ class VirtualTables:
     def palf(self):
         rows = []
         for tname, tenant in self.db.tenants.items():
-            for rid, r in tenant.wal.replicas.items():
-                rows.append((tname, rid, r.role, r.current_term,
-                             r.last_lsn(), r.committed_lsn,
-                             rid in tenant.wal.down))
+            wal = tenant.wal
+            if hasattr(wal, "replicas"):
+                # in-process PalfCluster: every replica is visible
+                for rid, r in wal.replicas.items():
+                    rows.append((tname, rid, r.role, r.current_term,
+                                 r.last_lsn(), r.committed_lsn,
+                                 rid in wal.down))
+            elif hasattr(wal, "replica"):
+                # NetPalf: one local replica per process (peers are
+                # remote; query their v$palf for their state)
+                r = wal.replica
+                rows.append((tname, r.replica_id, r.role,
+                             r.current_term, r.last_lsn(),
+                             r.committed_lsn, False))
         return {
             "tenant": _obj(r[0] for r in rows),
             "replica_id": np.array([r[1] for r in rows], np.int64),
@@ -192,7 +226,8 @@ class VirtualTables:
         }
 
     def wait_events(self):
-        snap = self.db.wait_events.snapshot()
+        we = getattr(self.db, "wait_events", None)
+        snap = we.snapshot() if we is not None else {}
         return {
             "event": _obj(snap.keys()),
             "total_waits": np.array([c for c, _ in snap.values()], np.int64),
@@ -224,7 +259,8 @@ class VirtualTables:
     def dbms_jobs(self):
         """Scheduled-job registry + run history
         (≙ DBA_SCHEDULER_JOBS / __all_virtual_dbms_job)."""
-        jobs = self.db.jobs.jobs
+        sched = getattr(self.db, "jobs", None)
+        jobs = sched.jobs if sched is not None else {}
         names = sorted(jobs)
         return {
             "job_name": _obj(names),

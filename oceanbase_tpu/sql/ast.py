@@ -108,11 +108,9 @@ class SelectStmt:
     post_limit: Optional[int] = None
     post_offset: int = 0
     # when this SelectStmt is a CTE body: explicit column aliases from
-    # `WITH name (a, b) AS (...)`, and whether the WITH was RECURSIVE
-    # (≙ src/sql/engine/recursive_cte — the session materializes
-    # self-referencing CTEs to a fixpoint before binding)
+    # `WITH name (a, b) AS (...)`.  WITH RECURSIVE is rejected at parse
+    # time (no fixpoint materializer exists).
     cte_cols: list = field(default_factory=list)
-    is_recursive: bool = False
 
 
 @dataclass

@@ -394,12 +394,9 @@ class Binder:
         if name in self.ctes:
             sub = self.ctes[name]
             if name in self._cte_stack:
-                # a self-reference surviving to here means the session's
-                # recursive-CTE materializer didn't handle it (nested /
-                # non-top-level WITH RECURSIVE)
                 raise BindError(
-                    f"recursive reference to CTE {name!r} is only "
-                    "supported in a top-level WITH RECURSIVE")
+                    f"CTE {name!r} references itself; WITH RECURSIVE "
+                    "is not supported")
             self._cte_stack.add(name)
             try:
                 sub_plan, sub_outs, sub_est = self.bind_select(

@@ -262,7 +262,10 @@ class Parser:
     def parse_select(self) -> ast.SelectStmt:
         ctes = []
         if self.accept_kw("with"):
-            recursive = bool(self.accept_kw("recursive"))
+            if self.accept_kw("recursive"):
+                # no fixpoint materializer exists — reject loudly rather
+                # than silently treating the CTE as non-recursive
+                raise ParseError("WITH RECURSIVE is not supported")
             while True:
                 name = self.expect_ident()
                 cols = []
@@ -276,7 +279,6 @@ class Parser:
                 sub = self.parse_select()
                 self.expect_op(")")
                 sub.cte_cols = cols
-                sub.is_recursive = recursive
                 ctes.append((name, sub))
                 if not self.accept_op(","):
                     break

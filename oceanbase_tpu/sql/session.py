@@ -156,10 +156,25 @@ class Session:
         if vt is None:
             return
 
+        seen_views: set = set()
+
         def refresh(name):
             arrays = vt.provide(name)
             if arrays is not None:
                 self.catalog.register_transient(name, arrays)
+                return
+            # a view body may reference gv$/v$ tables too — walk it so
+            # they refresh per statement like direct references
+            vdef = self.catalog.view_def(name)
+            if vdef is None or name in seen_views:
+                return
+            seen_views.add(name)
+            try:
+                body = parse_sql(vdef["sql"])
+            except Exception:
+                return
+            if isinstance(body, ast.SelectStmt):
+                walk_sel(body)
 
         def walk_expr(e):
             if e is None or not isinstance(e, ir.Expr):
@@ -199,6 +214,10 @@ class Session:
             walk_sel(stmt.select)
         elif isinstance(stmt, (ast.UpdateStmt, ast.DeleteStmt)):
             walk_expr(stmt.where)
+        elif isinstance(stmt, ast.DescribeStmt):
+            # DESCRIBE on a gv$ table or on a view whose body reads one
+            # must materialize it before the binder expands the name
+            refresh(stmt.table)
 
     def execute_stmt(self, stmt, params=None) -> Result:
         if isinstance(stmt, ast.SelectStmt):
@@ -212,6 +231,16 @@ class Session:
             if self.catalog.drop_external(stmt.name):
                 return _ok()
             self.catalog.drop_table(stmt.name, if_exists=stmt.if_exists)
+            return _ok()
+        if isinstance(stmt, ast.CreateViewStmt):
+            self.catalog.create_view(stmt.name, stmt.sql_text,
+                                     cols=stmt.columns,
+                                     or_replace=stmt.or_replace)
+            return _ok()
+        if isinstance(stmt, ast.DropViewStmt):
+            if not self.catalog.drop_view(stmt.name) and \
+                    not stmt.if_exists:
+                raise KeyError(f"unknown view {stmt.name}")
             return _ok()
         if isinstance(stmt, ast.CreateExternalTableStmt):
             td = TableDef(stmt.name,
@@ -233,12 +262,15 @@ class Session:
         if isinstance(stmt, ast.DeleteStmt):
             return self._delete(stmt, params)
         if isinstance(stmt, ast.ShowTablesStmt):
-            names = self.catalog.tables()
+            names = sorted(set(self.catalog.tables())
+                           | set(self.catalog.view_names()))
             return Result(["table_name"],
                           {"table_name": np.array(names, dtype=object)},
                           {}, {"table_name": SqlType.string()},
                           rowcount=len(names))
         if isinstance(stmt, ast.DescribeStmt):
+            if self.catalog.view_def(stmt.table) is not None:
+                return self._describe_view(stmt.table)
             td = self.catalog.table_def(stmt.table)
             return Result(
                 ["field", "type", "null", "key"],
@@ -301,6 +333,17 @@ class Session:
         if isinstance(stmt, ast.TruncateStmt):
             return self._truncate(stmt)
         if isinstance(stmt, ast.ShowCreateStmt):
+            vdef = self.catalog.view_def(stmt.table)
+            if vdef is not None:
+                cols = (" (" + ", ".join(vdef["cols"]) + ")"
+                        if vdef.get("cols") else "")
+                text = (f"CREATE VIEW {stmt.table}{cols} AS "
+                        f"{vdef['sql']}")
+                return Result(
+                    ["view", "create_view"],
+                    {"view": np.array([stmt.table], dtype=object),
+                     "create_view": np.array([text], dtype=object)},
+                    {}, {}, rowcount=1)
             td = self.catalog.table_def(stmt.table)
             parts = []
             for c in td.columns:
@@ -694,6 +737,43 @@ class Session:
                 td.histograms.pop(c.name, None)
         return _ok()
 
+    def _describe_view(self, name: str) -> Result:
+        """DESCRIBE on a view: expand the body through the binder and
+        derive output names/types by running the plan over EMPTY typed
+        relations — a metadata command must not scan the view's base
+        tables.  Nullability/keys are not defined for a derived
+        relation."""
+        from oceanbase_tpu.exec.plan import referenced_tables
+        from oceanbase_tpu.vector import empty_relation
+
+        def typed(t):
+            td = self.catalog.table_def(t)
+            return empty_relation({c.name: c.dtype for c in td.columns})
+
+        plan, outputs, _est = self._plan_select(
+            parse_sql(f"select * from {name}"), None)
+        rel = execute_plan(
+            plan,
+            {t: typed(t) for t in referenced_tables(plan)
+             if self.catalog.has_table(t)},
+            check_overflow=False)
+        names, types = [], []
+        for cid, oname in outputs:
+            out_name, k = oname, 2
+            while out_name in names:
+                out_name = f"{oname}_{k}"
+                k += 1
+            names.append(out_name)
+            t = rel.columns[cid].dtype
+            types.append(str(t) if t is not None else "")
+        return Result(
+            ["field", "type", "null", "key"],
+            {"field": np.array(names, dtype=object),
+             "type": np.array(types, dtype=object),
+             "null": np.array(["YES"] * len(names), dtype=object),
+             "key": np.array([""] * len(names), dtype=object)},
+            {}, {}, rowcount=len(names))
+
     # ------------------------------------------------------------------
     def _plan_select(self, stmt: ast.SelectStmt, params):
         seqs = self.tenant.sequences if self.tenant is not None else None
@@ -749,11 +829,23 @@ class Session:
             res = self._try_spilled(plan, outputs, big)
             if res is not None:
                 return res
-        tables = {t: self._table_snapshot(t)
-                  for t in referenced_tables(plan)
-                  if self.catalog.has_table(t)}
-        self._try_ann_prefilter(plan, tables)
-        self._last_access_paths = self._index_prefilter(plan, tables)
+        tables: dict | None = None  # device relations, built lazily
+
+        def local_tables():
+            # deferred until a non-pushdown path needs them: DTL reads
+            # tablet snapshots on the data nodes itself, so a pushed-down
+            # query must not pay the full host->device materialization
+            nonlocal tables
+            if tables is None:
+                tables = {t: self._table_snapshot(t)
+                          for t in referenced_tables(plan)
+                          if self.catalog.has_table(t)}
+                self._try_ann_prefilter(plan, tables)
+                self._last_access_paths = self._index_prefilter(
+                    plan, tables)
+            return tables
+
+        self._last_access_paths = {}
         monitor = None
         if self.db is not None and \
                 getattr(self.db, "plan_monitor", None) is not None and \
@@ -763,16 +855,31 @@ class Session:
         factor = 1
         t0 = time.time()
         self._last_px = False  # did the last query run through PX?
+        self._last_dtl = False  # did it push down over the DTL exchange?
+        # cross-node compute pushdown (px/dtl.py): ship the partial plan
+        # to the cluster's data nodes instead of scanning everything on
+        # this node; an open transaction keeps the own-writes read path
+        dtl = (getattr(self.db, "dtl", None)
+               if self.db is not None and self._tx is None else None)
         for attempt in range(int(self.variables["max_capacity_retry"]) + 1):
             try:
                 p = plan if factor == 1 else scale_capacities(plan, factor)
                 rel = None
-                if dop > 1:
-                    rel = self._try_px(p, tables, dop, factor=factor,
-                                       monitor=monitor)
+                if dtl is not None:
+                    try:
+                        rel = dtl.try_execute(p, monitor=monitor)
+                    except CapacityOverflow:
+                        raise  # remote overflow: re-plan with 4x budgets
+                    except Exception:
+                        rel = None  # any exchange surprise -> serial path
+                    self._last_dtl = rel is not None
+                if rel is None and dop > 1:
+                    rel = self._try_px(p, local_tables(), dop,
+                                       factor=factor, monitor=monitor)
                     self._last_px = rel is not None
                 if rel is None:
-                    rel = execute_plan(p, tables, monitor_out=monitor)
+                    rel = execute_plan(p, local_tables(),
+                                       monitor_out=monitor)
                 break
             except CapacityOverflow:
                 if attempt >= int(self.variables["max_capacity_retry"]):

@@ -819,6 +819,10 @@ class StorageCatalog(Catalog):
 
     def create_table(self, tdef: TableDef, if_not_exists: bool = False):
         with self._lock:
+            # view-collision check inside the locked section (same
+            # check-then-act closure as Catalog.create_table)
+            if self.view_def(tdef.name) is not None:
+                raise ValueError(f"view {tdef.name} already exists")
             if tdef.name in self._defs or tdef.name in self._externals:
                 if if_not_exists:
                     return

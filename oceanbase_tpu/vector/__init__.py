@@ -17,6 +17,7 @@ from oceanbase_tpu.vector.column import (
     Column,
     Relation,
     StringDict,
+    empty_relation,
     from_numpy,
     to_numpy,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "Column",
     "Relation",
     "StringDict",
+    "empty_relation",
     "from_numpy",
     "to_numpy",
 ]

@@ -257,6 +257,25 @@ def from_numpy(
     return Relation(columns=cols, mask=None)
 
 
+def empty_relation(types: dict[str, "SqlType"]) -> Relation:
+    """One all-dead row typed after ``types`` (static shapes need
+    capacity >= 1): the canonical empty-table seed shared by CREATE
+    TABLE, transient registration, and type-only plan traces."""
+    arrays, valids = {}, {}
+    for name, t in types.items():
+        if t.is_string:
+            arrays[name] = np.array([""], dtype=object)
+        elif t.kind == TypeKind.VECTOR:
+            arrays[name] = np.zeros((1, t.precision or 1),
+                                    dtype=np.float32)
+        else:
+            arrays[name] = np.zeros(1, dtype=t.np_dtype)
+        valids[name] = np.array([False])
+    rel = from_numpy(arrays, types=types, valids=valids)
+    return Relation(columns=rel.columns,
+                    mask=jnp.zeros(1, dtype=jnp.bool_))
+
+
 def to_numpy(rel: Relation, limit: int | None = None) -> dict[str, np.ndarray]:
     """Materialize live rows back to host (decoding string dictionaries).
 

@@ -1,0 +1,189 @@
+"""DTL pushdown micro-bench: bytes-on-wire, plan pushdown vs snapshot pull.
+
+Boots a real 3-node cluster (subprocess nodes, TCP rpc), loads a TPC-H
+lineitem slice, then runs a Q6-style scan-aggregate (and a Q1-style
+group-by) two ways:
+
+- **pushdown**: the DTL exchange ships the partial plan to every node;
+  only partial aggregate states return (px/dtl.py);
+- **pull**: the legacy remote-read path pages the whole snapshot to the
+  coordinator over ``das.scan``.
+
+Prints ONE JSON line with both byte counts and their ratio.
+
+    python scripts/dtl_bench.py          # BENCH_ROWS=20000 by default
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from oceanbase_tpu.net.rpc import RpcClient  # noqa: E402
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def boot_cluster(root, n=3):
+    ports = _free_ports(n)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = []
+    for i in range(1, n + 1):
+        peers = ",".join(f"{j}=127.0.0.1:{ports[j - 1]}"
+                         for j in range(1, n + 1) if j != i)
+        cmd = [sys.executable, "-m", "oceanbase_tpu.net.node",
+               "--node-id", str(i), "--port", str(ports[i - 1]),
+               "--peers", peers, "--root", os.path.join(root, f"n{i}")]
+        if i == 1:
+            cmd.append("--bootstrap")
+        procs.append(subprocess.Popen(cmd, env=env,
+                                      stdout=subprocess.DEVNULL,
+                                      stderr=subprocess.DEVNULL))
+    clients = {i: RpcClient("127.0.0.1", ports[i - 1], timeout_s=60.0)
+               for i in range(1, n + 1)}
+    deadline = time.time() + 60
+    for i, cli in clients.items():
+        while time.time() < deadline:
+            if cli.ping():
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError(f"node {i} not ready")
+    return procs, clients
+
+
+def wait_converged(clients, table, n_rows, timeout=120):
+    deadline = time.time() + timeout
+    for i in (2, 3):
+        while time.time() < deadline:
+            try:
+                r = clients[i].call("sql.execute",
+                                    sql=f"select count(*) from {table}",
+                                    consistency="weak")
+                cnt = int(r["arrays"][r["names"][0]][0])
+                if r["node"] == i and cnt == n_rows:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.3)
+        else:
+            raise TimeoutError(f"node {i} never converged")
+
+
+def pull_bytes(cli, table):
+    """Node 1 pulls the whole snapshot FROM node 2 over das.scan (the
+    legacy remote-read path) and reports the node-to-node wire cost —
+    apples-to-apples with the pushdown's node-to-node exchange bytes."""
+    r = cli.call("das.pull", table=table, node_id=2)
+    return r["bytes"], r["rows"]
+
+
+def last_exchange(cli):
+    r = cli.call("sql.execute", sql=(
+        "select bytes_shipped, rows_shipped, parts, pushdown_hit,"
+        " elapsed_s from gv$px_exchange where mode = 'pushdown'"
+        " order by ts desc limit 1"))
+    a = r["arrays"]
+    return {k: v[0].item() if hasattr(v[0], "item") else v[0]
+            for k, v in a.items()}
+
+
+def main():
+    n_rows = int(os.environ.get("BENCH_ROWS", "20000"))
+    query = os.environ.get("BENCH_QUERY", "q6")
+    root = tempfile.mkdtemp(prefix="dtlbench_")
+    procs = []
+    try:
+        procs, clients = boot_cluster(root)
+        c1 = clients[1]
+
+        def sql(text):
+            return c1.call("sql.execute", sql=text)
+
+        sql("create table lineitem (l_id int primary key,"
+            " l_quantity int, l_extendedprice int, l_discount int,"
+            " l_shipdate int, l_returnflag int, l_linestatus int)")
+        rng = np.random.default_rng(1)
+        qty = rng.integers(1, 50, n_rows)
+        price = rng.integers(1000, 100000, n_rows)
+        disc = rng.integers(0, 10, n_rows)
+        ship = rng.integers(8766, 10227, n_rows)  # ~1994-1997 in days
+        rf = rng.integers(0, 3, n_rows)
+        ls = rng.integers(0, 2, n_rows)
+        t_load = time.time()
+        for s in range(0, n_rows, 1000):
+            e = min(s + 1000, n_rows)
+            vals = ", ".join(
+                f"({i}, {qty[i]}, {price[i]}, {disc[i]}, {ship[i]},"
+                f" {rf[i]}, {ls[i]})" for i in range(s, e))
+            sql(f"insert into lineitem values {vals}")
+        t_load = time.time() - t_load
+        wait_converged(clients, "lineitem", n_rows)
+        sql("alter system set dtl_min_rows = 1")
+
+        if query == "q1":
+            q = ("select l_returnflag, l_linestatus, sum(l_quantity),"
+                 " sum(l_extendedprice), avg(l_discount), count(*)"
+                 " from lineitem where l_shipdate <= 10000"
+                 " group by l_returnflag, l_linestatus"
+                 " order by l_returnflag, l_linestatus")
+        else:
+            q = ("select sum(l_extendedprice * l_discount)"
+                 " from lineitem where l_shipdate >= 8766"
+                 " and l_shipdate < 9131 and l_discount >= 5"
+                 " and l_discount <= 7 and l_quantity < 24")
+        t0 = time.time()
+        sql(q)
+        push_s = time.time() - t0
+        ex = last_exchange(c1)
+        assert ex["pushdown_hit"] == 1, "query did not push down"
+
+        t0 = time.time()
+        pbytes, prow = pull_bytes(c1, "lineitem")
+        pull_s = time.time() - t0
+
+        print(json.dumps({
+            "metric": "dtl_bytes_on_wire",
+            "query": query, "rows": n_rows,
+            "pushdown_bytes": int(ex["bytes_shipped"]),
+            "pushdown_rows_shipped": int(ex["rows_shipped"]),
+            "pushdown_parts": int(ex["parts"]),
+            "pushdown_s": round(push_s, 4),
+            "pull_bytes": int(pbytes),
+            "pull_s": round(pull_s, 4),
+            "bytes_ratio": round(ex["bytes_shipped"] / max(pbytes, 1), 6),
+            "load_s": round(t_load, 2),
+        }))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
