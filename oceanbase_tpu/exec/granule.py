@@ -26,9 +26,16 @@ from oceanbase_tpu.exec import plan as pp
 from oceanbase_tpu.expr import ir
 from oceanbase_tpu.px.dist_ops import split_aggs
 from oceanbase_tpu.px.planner import NotDistributable, split_top
-from oceanbase_tpu.vector import Relation, from_numpy
+from oceanbase_tpu.vector import Relation, bucket_capacity, from_numpy
 
 DEFAULT_CHUNK_ROWS = 1 << 21  # ~2M rows per granule
+
+
+def snap_chunk_rows(chunk_rows: int) -> int:
+    """Snap a granule capacity onto the shared bucket ladder: chunk
+    programs compile per chunk shape, so an arbitrary (config-derived)
+    chunk size must not mint a fresh executable per value."""
+    return bucket_capacity(chunk_rows)
 
 
 def _find_single_scan(node):
@@ -185,6 +192,7 @@ def execute_streamed(plan: pp.PlanNode, chunk_provider,
     Pass the same ``cache`` dict across calls to reuse the compiled chunk
     program and the string dictionaries (repeat executions of one plan).
     """
+    chunk_rows = snap_chunk_rows(chunk_rows)
     top, scalar_agg, droot = split_top(plan)
 
     # peel a GroupBy into partial (per-granule) + final (merge) phases
@@ -294,6 +302,7 @@ def execute_sorted_streamed(
     from oceanbase_tpu.storage.tmpfile import TempFileStore
     from oceanbase_tpu.vector import to_numpy
 
+    chunk_rows = snap_chunk_rows(chunk_rows)
     top, scalar_agg, droot = split_top(plan)
     if scalar_agg is not None or isinstance(droot, pp.GroupBy):
         raise NotDistributable("sorted streaming is for scan pipelines")

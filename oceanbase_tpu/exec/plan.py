@@ -14,6 +14,9 @@ hooks at this layer via the plan monitor (server/monitor.py).
 from __future__ import annotations
 
 import functools
+import hashlib
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -23,6 +26,62 @@ from oceanbase_tpu.exec import diag, ops
 from oceanbase_tpu.exec.ops import AggSpec
 from oceanbase_tpu.expr import ir
 from oceanbase_tpu.vector.column import Relation
+
+
+# ---------------------------------------------------------------------------
+# plan-cache observability (≙ ObPlanCache stat views: gv$plan_cache)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PlanCacheEntry:
+    """Per-plan compile/execute counters surfaced by ``gv$plan_cache``.
+
+    ``xla_traces`` counts XLA retrace events — the expensive part the
+    shape-bucket policy amortizes; ``executions - xla_traces`` is the
+    number of calls served entirely by an already-compiled executable.
+    """
+
+    plan_hash: str            # stable digest of the plan fingerprint
+    plan_text: str            # fingerprint prefix (human-readable)
+    executions: int = 0       # execute_plan calls for this fingerprint
+    xla_traces: int = 0       # trace (compile) events across all shapes
+    last_compile_s: float = 0.0  # wall time of the last traced execution
+    created_ts: float = field(default_factory=time.time)
+
+    @property
+    def hit_count(self) -> int:
+        return max(self.executions - self.xla_traces, 0)
+
+
+_PLAN_STATS: dict[str, PlanCacheEntry] = {}
+_PLAN_STATS_LOCK = threading.Lock()
+_PLAN_STATS_MAX = 4096
+
+
+def _stats_for(key: str) -> PlanCacheEntry:
+    # registry keyed by digest: full fingerprints are whole-plan reprs
+    # (arbitrarily long) and must not be pinned per entry
+    digest = hashlib.md5(key.encode()).hexdigest()
+    with _PLAN_STATS_LOCK:
+        e = _PLAN_STATS.get(digest)
+        if e is None:
+            if len(_PLAN_STATS) >= _PLAN_STATS_MAX:
+                _PLAN_STATS.pop(next(iter(_PLAN_STATS)))
+            e = PlanCacheEntry(plan_hash=digest, plan_text=key[:120])
+            _PLAN_STATS[digest] = e
+        return e
+
+
+def plan_cache_stats() -> list[PlanCacheEntry]:
+    """Snapshot of per-plan compile/execute counters (gv$plan_cache)."""
+    with _PLAN_STATS_LOCK:
+        return list(_PLAN_STATS.values())
+
+
+def reset_plan_cache_stats():
+    with _PLAN_STATS_LOCK:
+        _PLAN_STATS.clear()
 
 
 class PlanNode:
@@ -247,9 +306,14 @@ def _compiled(plan_key, plan_holder, with_monitor=False):
     plan = plan_holder.plan
     diag_names: list[str] = []     # filled at trace time
     monitor_names: list[str] = []
+    stats = _stats_for(plan_key)
 
     @jax.jit
     def run(tables):
+        # trace-time side effect: the body only executes when jit
+        # retraces (a new input shape/dtype/aux combination), so this
+        # counts exactly the compile events
+        stats.xla_traces += 1
         with diag.collect() as entries:
             if with_monitor:
                 with diag.monitor_collect() as mons:
@@ -264,7 +328,11 @@ def _compiled(plan_key, plan_holder, with_monitor=False):
         diag_names.extend(n for n, _ in entries)
         return out, [v for _, v in entries], mvals
 
-    return run, diag_names, monitor_names
+    # the stats object rides along with the compiled entry: the closure
+    # above increments THIS object at trace time, so callers must count
+    # executions on the same one (a fresh _stats_for lookup could return
+    # a new entry after registry eviction and desync the counters)
+    return run, diag_names, monitor_names, stats
 
 
 class _PlanHolder:
@@ -298,10 +366,15 @@ def execute_plan(plan: PlanNode, tables: dict[str, Relation],
     key = plan.fingerprint()
     needed = referenced_tables(plan)
     with_monitor = monitor_out is not None
-    run, diag_names, monitor_names = _compiled(
+    run, diag_names, monitor_names, stats = _compiled(
         key, _PlanHolder(plan, key), with_monitor)
+    traces_before = stats.xla_traces
+    t0 = time.perf_counter()
     out, diag_vals, mon_vals = run(
         {k: v for k, v in tables.items() if k in needed})
+    stats.executions += 1
+    if stats.xla_traces > traces_before:
+        stats.last_compile_s = time.perf_counter() - t0
     if with_monitor:
         monitor_out.extend(
             (n, int(v)) for n, v in zip(monitor_names, mon_vals))

@@ -50,6 +50,7 @@ from oceanbase_tpu.exec.granule import (
     _find_single_scan,
     _global_dicts,
     extract_column_bounds,
+    snap_chunk_rows,
 )
 from oceanbase_tpu.exec.spill import partitioned_join_spilled
 from oceanbase_tpu.expr import ir
@@ -119,6 +120,9 @@ def execute_spilled(plan: pp.PlanNode, providers: dict, spill_dir: str,
     every other referenced table (lowered whole).  -> (arrays, valids,
     dtypes, SpillStats); raises NotDistributable for unsupported shapes.
     """
+    # granule capacity rides the shared bucket ladder so the per-chunk
+    # device programs compile once per ladder rung, not per config value
+    chunk_rows = snap_chunk_rows(chunk_rows)
     top, scalar_agg, droot = split_top(plan)
     group_node = None
     if isinstance(droot, pp.GroupBy):

@@ -404,32 +404,16 @@ def slice_mask(arrays: dict, key_cols, part: int, nparts: int):
 
 
 def host_relation(arrays: dict, valids: dict, types: dict) -> Relation:
-    """Host columns -> device Relation padded to a power-of-two capacity
-    (bounds jit retraces across slice sizes) with a live-row mask."""
-    import jax.numpy as jnp
+    """Host columns -> device Relation padded onto the shared
+    capacity-bucket ladder (bounds jit retraces across slice sizes)
+    with a live-row mask."""
+    from oceanbase_tpu.vector import bucket_capacity
 
     n = len(next(iter(arrays.values()))) if arrays else 0
-    cap = 1
-    while cap < max(n, 1):
-        cap <<= 1
-    if cap > n:
-        pad = cap - n
-        arrays = {
-            c: np.concatenate([
-                np.asarray(a),
-                np.array([""] * pad, dtype=object)
-                if np.asarray(a).dtype == object
-                else np.zeros(pad, dtype=np.asarray(a).dtype)])
-            for c, a in arrays.items()}
-        valids = {c: np.concatenate(
-            [v if v is not None else np.ones(n, dtype=bool),
-             np.zeros(pad, dtype=bool)])
-            for c, v in valids.items() if v is not None}
     rel = from_numpy(
         arrays, types=types,
         valids={k: v for k, v in valids.items() if v is not None})
-    mask = jnp.asarray(np.arange(cap) < n)
-    return Relation(columns=rel.columns, mask=mask)
+    return rel.pad_to(bucket_capacity(n))
 
 
 def execute_fragment(ts, plan_enc: dict, snapshot: int, part: int,

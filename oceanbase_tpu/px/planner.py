@@ -74,6 +74,18 @@ def _row_bytes(rel) -> int:
         b += c.data.dtype.itemsize + (1 if c.valid is not None else 0)
     return max(b, 1)
 
+
+def _snap_budget(n: int) -> int:
+    """Exchange buffer budgets ride the shared capacity-bucket ladder:
+    they derive from input capacities, and an arbitrary per-capacity
+    value would mint a fresh shard program per table size even when the
+    inputs themselves are bucket-padded.  Rounding UP never drops rows —
+    overflow stays counted and retried as before."""
+    from oceanbase_tpu.vector.column import bucket_capacity
+
+    return bucket_capacity(n, floor=1024)
+
+
 _DIST_OK = (pp.TableScan, pp.Filter, pp.Project, pp.GroupBy,
             pp.HashJoin, pp.SemiJoinResidual, pp.Union, pp.Compact,
             pp.Window, pp.ScalarAgg)
@@ -310,8 +322,8 @@ def _dlower(node: pp.PlanNode, tables: dict, ndev: int, axis: str,
             from oceanbase_tpu.px.exchange import all_to_all_repartition
 
             if node.keys:
-                per_dest = max((child.capacity + ndev - 1) // ndev * 2,
-                               1024) * factor
+                per_dest = _snap_budget(
+                    (child.capacity + ndev - 1) // ndev * 2) * factor
                 recv, ovf = all_to_all_repartition(
                     child, list(node.keys.values()), ndev, per_dest,
                     axis)
@@ -380,8 +392,8 @@ def _dlower(node: pp.PlanNode, tables: dict, ndev: int, axis: str,
         keys = pkeys[1]
         if not _keys_hash_partitionable(child, child, keys, keys):
             raise NotDistributable("window partition keys not hashable")
-        per_dest = max((child.capacity + ndev - 1) // ndev * 2,
-                       1024) * factor
+        per_dest = _snap_budget(
+            (child.capacity + ndev - 1) // ndev * 2) * factor
         recv, ovf = all_to_all_repartition(child, keys, ndev, per_dest,
                                            axis)
         diag.push("px_exchange_overflow", ovf)
@@ -401,8 +413,9 @@ def _dlower(node: pp.PlanNode, tables: dict, ndev: int, axis: str,
             # Weak #5)
             from oceanbase_tpu.px.exchange import all_to_all_repartition
 
-            per_dest = max((max(left.capacity, right.capacity) + ndev - 1)
-                           // ndev * 2, 1024) * factor
+            per_dest = _snap_budget(
+                (max(left.capacity, right.capacity) + ndev - 1)
+                // ndev * 2) * factor
             lrecv, lov = all_to_all_repartition(
                 left, node.left_keys, ndev, per_dest, axis)
             rrecv, rov = all_to_all_repartition(
@@ -476,8 +489,9 @@ def _djoin(left, right, lkeys, rkeys, how, cap, ndev, axis, factor=1):
                                                      lkeys, rkeys):
             raise NotDistributable("full outer join needs "
                                    "hash-partitionable keys")
-        per_dest = max((max(left.capacity, right.capacity) + ndev - 1)
-                       // ndev * 2, 1024) * factor
+        per_dest = _snap_budget(
+            (max(left.capacity, right.capacity) + ndev - 1)
+            // ndev * 2) * factor
         local_cap = cap if cap is None else max(cap // ndev * 2, 1024)
         out, ovf = dist_join_shard(
             left, right, lkeys, rkeys, ndev=ndev, cap_per_dest=per_dest,
@@ -497,8 +511,9 @@ def _djoin(left, right, lkeys, rkeys, how, cap, ndev, axis, factor=1):
     # per-destination budget scales with the session's retry factor
     # because exchange caps derive from input capacities, which plan-level
     # scale_capacities cannot reach
-    per_dest = max((max(left.capacity, right.capacity) + ndev - 1)
-                   // ndev * 2, 1024) * factor
+    per_dest = _snap_budget(
+        (max(left.capacity, right.capacity) + ndev - 1)
+        // ndev * 2) * factor
     if how in ("inner", "semi"):
         # runtime join filter (≙ ObPxBloomFilter through the datahub):
         # the build side's key bitmap kills probe rows BEFORE the probe
@@ -573,7 +588,8 @@ def _px_compiled(plan_key, holder, mesh, axis, ndev, factor, table_names):
                 # per-(sender,dest) budget: local rows average out at
                 # capacity/ndev per destination; skew overflows are
                 # counted and the session retry loop scales ``factor``
-                cap = max(rel.capacity * 2 // ndev, 128) * factor
+                cap = _snap_budget(
+                    max(rel.capacity * 2 // ndev, 128)) * factor
                 rel, s_ovf = dist_sort_shard(
                     rel, list(keys), list(asc) if asc else None,
                     ndev, cap, axis)

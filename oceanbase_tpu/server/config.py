@@ -76,6 +76,19 @@ DEF("enable_plan_cache", True, "bool",
     "cache bound physical plans keyed by parameterized SQL text")
 DEF("plan_cache_mem_limit", 512 << 20, "cap",
     "plan cache memory budget in bytes", _pos)
+DEF("enable_shape_buckets", True, "bool",
+    "pad device relations materialized from storage to geometric "
+    "capacity buckets (dead lanes masked) so a table growing inside "
+    "one bucket reuses the same compiled XLA executable instead of "
+    "retracing every plan per row-count change")
+DEF("shape_bucket_growth", 2.0, "float",
+    "geometric growth factor of the storage-materialization bucket "
+    "ladder (derived chunk/exchange budgets use the default ladder)",
+    lambda v: v >= 1.125)
+DEF("shape_bucket_floor", 64, "int",
+    "smallest capacity bucket (tables below it pad up to the floor); "
+    "governs storage materialization — derived chunk/exchange budgets "
+    "use the default ladder", _pos)
 DEF("query_timeout_s", 3600, "int", "per-statement timeout seconds", _pos)
 
 # PX / distributed

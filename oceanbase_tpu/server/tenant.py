@@ -67,7 +67,8 @@ class Tenant:
              for s, _ in ts.tablet.segment_locations()), default=0))
 
         self.catalog = StorageCatalog(self.engine,
-                                      snapshot_fn=self.tx.gts.current)
+                                      snapshot_fn=self.tx.gts.current,
+                                      config=self.config)
         self.catalog._cache.resize(int(self.config["kv_cache_limit_bytes"]))
 
         # satellites: sequences, table locks, KV/CDC front-ends
@@ -85,6 +86,11 @@ class Tenant:
                 self.tx.lock_wait_timeout_s = float(v)
             elif k == "kv_cache_limit_bytes":
                 self.catalog._cache.resize(int(v))
+            elif k in ("enable_shape_buckets", "shape_bucket_growth",
+                       "shape_bucket_floor"):
+                # cached relations were padded under the old policy;
+                # drop them so the next read re-materializes
+                self.catalog._cache.invalidate()
 
         # hot-reload from the tenant overlay AND the cluster config
         self.config.watch(_on_cfg)

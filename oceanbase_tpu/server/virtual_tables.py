@@ -31,6 +31,7 @@ class VirtualTables:
         return {
             "gv$sql_audit": self.sql_audit,
             "gv$plan_monitor": self.plan_monitor,
+            "gv$plan_cache": self.plan_cache,
             "gv$px_exchange": self.px_exchange,
             "v$session_history": self.session_history,
             "v$parameters": self.parameters,
@@ -75,6 +76,35 @@ class VirtualTables:
             "operator": _obj(r[2] for r in rows),
             "output_rows": np.array([r[3] for r in rows], np.int64),
             "plan_elapsed_s": np.array([r[4] for r in rows], np.float64),
+        }
+
+    def plan_cache(self):
+        """Compiled-plan cache counters (≙ ObPlanCache stat view,
+        gv$plan_cache): per plan fingerprint, how often it executed, how
+        often XLA had to (re)trace — the cost the shape-bucket policy
+        amortizes — and the wall time of the last traced execution.
+
+        Entries are PROCESS-wide, mirroring the process-global XLA
+        executable cache they instrument (exec.plan._compiled) — in a
+        multi-tenant process the view spans tenants, like the gv$
+        prefix advertises."""
+        from oceanbase_tpu.exec.plan import plan_cache_stats
+
+        entries = sorted(plan_cache_stats(),
+                         key=lambda e: -e.executions)
+        return {
+            "plan_hash": _obj(e.plan_hash for e in entries),
+            "plan_text": _obj(e.plan_text for e in entries),
+            "executions": np.array([e.executions for e in entries],
+                                   np.int64),
+            "hit_count": np.array([e.hit_count for e in entries],
+                                  np.int64),
+            "xla_trace_count": np.array([e.xla_traces for e in entries],
+                                        np.int64),
+            "last_compile_s": np.array([e.last_compile_s
+                                        for e in entries], np.float64),
+            "created_ts": np.array([e.created_ts for e in entries],
+                                   np.float64),
         }
 
     def px_exchange(self):

@@ -84,7 +84,13 @@ class Session:
         self.variables: dict[str, object] = {
             "autocommit": 1, "max_capacity_retry": self.MAX_CAPACITY_RETRIES,
         }
-        self.plan_cache: dict[str, tuple] = {}
+        from collections import OrderedDict
+
+        # LRU plan cache: most-recently-used last; byte-accounted against
+        # plan_cache_mem_limit (≙ ObPlanCache memory-bounded eviction)
+        self.plan_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._plan_cache_bytes: dict[tuple, int] = {}
+        self._plan_cache_total = 0
         self._last_spill = None  # SpillStats of the last spilled query
         self._tx = None  # active explicit transaction (BEGIN ... COMMIT)
         self._ash_state = {"active": False, "sql": "", "state": "idle"}
@@ -790,16 +796,50 @@ class Session:
         key = (sql_key, tuple(params or []), self.catalog.schema_version)
         hit = self.plan_cache.get(key)
         if hit is not None:
+            self.plan_cache.move_to_end(key)  # LRU touch
             return hit
         seqs = self.tenant.sequences if self.tenant is not None else None
         binder = Binder(self.catalog, params=params or [], sequences=seqs,
                         sysvars=self.variables)
         out = binder.bind_select(stmt)
         if not binder.folded_volatile:
-            if len(self.plan_cache) > 512:
-                self.plan_cache.clear()  # crude eviction; LRU later
-            self.plan_cache[key] = out
+            self._plan_cache_put(key, out)
         return out
+
+    # session plan-cache sizing: entries are python plan trees whose
+    # live-object footprint far exceeds their repr — the fingerprint
+    # length tracks node count (~100 chars/node), and each dataclass
+    # node with its expr objects costs on the order of 1KB, so charge
+    # ~10 bytes of estimate per fingerprint char plus a fixed overhead
+    _PLAN_ENTRY_OVERHEAD = 2048
+    _PLAN_BYTES_PER_CHAR = 10
+    _PLAN_CACHE_MAX_ENTRIES = 4096  # backstop against tiny-entry floods
+
+    def _plan_cache_put(self, key, out):
+        """Insert with real LRU eviction (oldest first) honoring
+        ``plan_cache_mem_limit`` (and an entry-count backstop)."""
+        try:
+            fp = out[0].fingerprint()
+        except Exception:
+            fp = ""
+        nbytes = self._PLAN_ENTRY_OVERHEAD + \
+            self._PLAN_BYTES_PER_CHAR * (len(str(key[0])) + len(fp))
+        limit = (int(self.db.config["plan_cache_mem_limit"])
+                 if self.db is not None else 512 << 20)
+        if nbytes > limit:
+            return  # a single over-budget plan is not cacheable
+        old = self._plan_cache_bytes.pop(key, None)
+        if old is not None:
+            self._plan_cache_total -= old
+            self.plan_cache.pop(key, None)
+        self.plan_cache[key] = out
+        self._plan_cache_bytes[key] = nbytes
+        self._plan_cache_total += nbytes
+        while self.plan_cache and (
+                self._plan_cache_total > limit
+                or len(self.plan_cache) > self._PLAN_CACHE_MAX_ENTRIES):
+            k, _ = self.plan_cache.popitem(last=False)
+            self._plan_cache_total -= self._plan_cache_bytes.pop(k, 0)
 
     def _table_snapshot(self, name: str):
         """Read a table at the right snapshot: an active transaction sees
@@ -894,6 +934,16 @@ class Session:
                 factor *= 4
                 if monitor is not None:
                     monitor.clear()
+        if factor > 1 and use_cache:
+            # evolve the cached plan: a plan bound against a smaller
+            # table keeps overflowing its stale capacity budgets, which
+            # would replay the whole (device-executing) retry ladder on
+            # EVERY later execution — cache the successfully scaled plan
+            # in its place so the next run starts where this one ended
+            key = (self._ash_state["sql"], tuple(params or []),
+                   self.catalog.schema_version)
+            if key in self.plan_cache:
+                self._plan_cache_put(key, (p, outputs, _est))
         if monitor is not None:
             self.db.plan_monitor.record(
                 plan.fingerprint()[:64] if hasattr(plan, "fingerprint")
@@ -967,14 +1017,19 @@ class Session:
         if vix is None:
             return
         rel = tables.get(cur.table)
-        if rel is None or rel.capacity <= max(k * self._ANN_FETCH_FACTOR,
-                                              64):
+        if rel is None:
+            return
+        import numpy as _np
+
+        n_live = (rel.capacity if rel.mask is None
+                  else int(_np.asarray(rel.mask).sum()))
+        if n_live <= max(k * self._ANN_FETCH_FACTOR, 64):
             return
         from oceanbase_tpu.expr.compile import parse_vector_text
 
         q = parse_vector_text(lit.value)[None, :]
         idx = self._ann_runtime(cur.table, base_col, metric, rel)
-        fetch = min(max(k * self._ANN_FETCH_FACTOR, 64), rel.capacity)
+        fetch = min(max(k * self._ANN_FETCH_FACTOR, 64), n_live)
         if idx is None:
             return
         import numpy as _np
@@ -1021,8 +1076,14 @@ class Session:
         if colv is None or _np.asarray(colv.data).ndim != 2:
             return None
         vecs = _np.asarray(colv.data)
-        if rel.mask is not None and not bool(_np.asarray(rel.mask).all()):
-            return None  # dead rows would need an id remap; skip
+        if rel.mask is not None:
+            m = _np.asarray(rel.mask)
+            n_live = int(m.sum())
+            if not bool(m[:n_live].all()):
+                # interior dead rows would need an id remap; skip
+                # (bucket padding is a dead SUFFIX, which slices clean)
+                return None
+            vecs = vecs[:n_live]
         # IVF (approximate recall) ONLY when the index opted in with
         # WITH (approximate = true) — index DDL must never silently
         # change the answers of an unchanged exact query
@@ -1077,33 +1138,17 @@ class Session:
 
     @staticmethod
     def _candidate_relation(ts, arrays, valids):
-        """Host candidate arrays -> device Relation padded to a power-of-
-        two capacity (bounds jit-cache entries) with a live-row mask."""
-        import jax.numpy as jnp
-
-        from oceanbase_tpu.vector import Relation
+        """Host candidate arrays -> device Relation padded onto the shared
+        capacity-bucket ladder (bounds jit-cache entries) with a live-row
+        mask."""
+        from oceanbase_tpu.vector import bucket_capacity
 
         n = len(next(iter(arrays.values()))) if arrays else 0
-        cap = 1
-        while cap < max(n, 1):
-            cap <<= 1
-        types = {c.name: c.dtype for c in ts.tdef.columns}
-        if cap > n:
-            pad = cap - n
-            arrays = {
-                c: np.concatenate([
-                    a, np.array([""] * pad, dtype=object)
-                    if a.dtype == object else np.zeros(pad, dtype=a.dtype)])
-                for c, a in arrays.items()}
-            valids = {c: np.concatenate(
-                [v if v is not None else np.ones(n, dtype=bool),
-                 np.zeros(pad, dtype=bool)])
-                for c, v in valids.items()}
         rel = from_numpy(
-            arrays, types=types,
+            arrays,
+            types={c.name: c.dtype for c in ts.tdef.columns},
             valids={k: v for k, v in valids.items() if v is not None})
-        mask = jnp.asarray(np.arange(cap) < n)
-        return Relation(columns=rel.columns, mask=mask)
+        return rel.pad_to(bucket_capacity(n))
 
     def _px_dop(self) -> int:
         """Effective degree of parallelism.  A session px_dop wins over the
@@ -1976,6 +2021,10 @@ class Session:
 
         self._run_in_tx(op)
         self.catalog.invalidate(stmt.table)
+        # keep the binder's est_rows current: a plan bound while the
+        # table looked empty would budget capacities for one row and
+        # ride the CapacityOverflow retry ladder on every execution
+        td.row_count = tablet.row_count_estimate()
         self._maybe_freeze(stmt.table)
         return _ok(rowcount=len(rows_values))
 

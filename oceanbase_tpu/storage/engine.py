@@ -723,11 +723,15 @@ class StorageCatalog(Catalog):
     """Catalog backed by the storage engine: table_data() materializes a
     snapshot Relation from the tablet LSM with device-side caching."""
 
-    def __init__(self, engine: StorageEngine, snapshot_fn=None):
+    def __init__(self, engine: StorageEngine, snapshot_fn=None,
+                 config=None):
         super().__init__()
         self.engine = engine
         # snapshot provider (GTS reader); default: latest
         self.snapshot_fn = snapshot_fn or (lambda: 2**62)
+        # bucket-policy knobs (enable_shape_buckets & co.) read live from
+        # the tenant config when one is attached; defaults otherwise
+        self.config = config
         # device-relation cache: decoded HBM-resident columns behind a
         # byte-bounded LRU (≙ ObKVGlobalCache block cache,
         # src/share/cache/ob_kv_storecache.h:91)
@@ -912,6 +916,37 @@ class StorageCatalog(Catalog):
             self.schema_version += 1
             self._cache.invalidate(name)
 
+    # -- capacity bucketing (the static-shape policy) --------------------
+    def _bucket_policy(self):
+        """-> (enabled, floor, growth), read live from the attached
+        config so ALTER SYSTEM toggles apply to the next
+        materialization."""
+        from oceanbase_tpu.vector.column import (
+            DEFAULT_BUCKET_FLOOR,
+            DEFAULT_BUCKET_GROWTH,
+        )
+
+        cfg = self.config
+        if cfg is None:
+            return True, DEFAULT_BUCKET_FLOOR, DEFAULT_BUCKET_GROWTH
+        try:
+            return (bool(cfg["enable_shape_buckets"]),
+                    int(cfg["shape_bucket_floor"]),
+                    float(cfg["shape_bucket_growth"]))
+        except KeyError:
+            return True, DEFAULT_BUCKET_FLOOR, DEFAULT_BUCKET_GROWTH
+
+    def _bucketed(self, rel):
+        """Pad a freshly materialized relation to its capacity bucket
+        (dead lanes masked) so every snapshot inside one bucket presents
+        the same static shape to the compiled-plan cache."""
+        from oceanbase_tpu.vector.column import bucket_capacity
+
+        enabled, floor, growth = self._bucket_policy()
+        if not enabled:
+            return rel
+        return rel.pad_to(bucket_capacity(rel.capacity, floor, growth))
+
     def table_data(self, name):
         from oceanbase_tpu.vector import from_numpy
 
@@ -935,14 +970,17 @@ class StorageCatalog(Catalog):
                 # static shapes need capacity >= 1: one all-dead row
                 rel = self._empty_rel(ts)
             else:
-                rel = from_numpy(
+                rel = self._bucketed(from_numpy(
                     arrays,
                     types={c.name: c.dtype for c in ts.tdef.columns},
                     valids={k: v for k, v in valids.items() if v is not None},
-                )
+                ))
             # only cache snapshots that cover every persisted segment —
             # a snapshot below a segment's max_version would pin a
-            # partial view that later (larger) snapshots must not reuse
+            # partial view that later (larger) snapshots must not reuse.
+            # The cached value is the bucket-padded relation, so every
+            # snapshot read inside the bucket (table_data_at included)
+            # reuses one HBM-resident copy AND one compiled shape.
             seg_max = max((s.max_version
                            for s, _ in ts.tablet.segment_locations()),
                           default=0)
@@ -951,7 +989,10 @@ class StorageCatalog(Catalog):
 
                 self._cache.put(name, (ver, rel),
                                 nbytes=relation_bytes(rel))
-            ts.tdef.row_count = rel.capacity
+            # record the LIVE row count, not the padded capacity: the
+            # binder's est_rows drives join/groupby capacity budgets and
+            # spill decisions, which must not drift with pad lanes
+            ts.tdef.row_count = n
             return rel
 
     def table_data_at(self, name, snapshot: int, tx_id: int = 0):
@@ -981,10 +1022,13 @@ class StorageCatalog(Catalog):
         n = len(next(iter(arrays.values()))) if arrays else 0
         if n == 0:
             return self._empty_rel(ts)
-        return from_numpy(
+        # snapshot reads pad to the SAME bucket ladder: a transaction
+        # re-reading a table it is growing keeps hitting one compiled
+        # shape per bucket instead of one per statement
+        return self._bucketed(from_numpy(
             arrays, types={c.name: c.dtype for c in ts.tdef.columns},
             valids={k: v for k, v in valids.items() if v is not None},
-        )
+        ))
 
     def _empty_rel(self, ts):
         import jax.numpy as jnp
@@ -1000,8 +1044,13 @@ class StorageCatalog(Catalog):
         rel = from_numpy(arrays,
                          types={c.name: c.dtype for c in ts.tdef.columns},
                          valids=valids2)
-        return Relation(columns=rel.columns,
-                        mask=jnp.zeros(1, dtype=jnp.bool_))
+        rel = Relation(columns=rel.columns,
+                       mask=jnp.zeros(1, dtype=jnp.bool_))
+        # empty tables pad to the floor bucket too: the canonical OLTP
+        # birth sequence (CREATE -> first INSERTs -> SELECT) then compiles
+        # once for the whole first bucket instead of once for "empty"
+        # plus once for "a few rows"
+        return self._bucketed(rel)
 
     def set_data(self, name, rel):
         raise NotImplementedError(

@@ -17,6 +17,7 @@ from oceanbase_tpu.vector.column import (
     Column,
     Relation,
     StringDict,
+    bucket_capacity,
     empty_relation,
     from_numpy,
     to_numpy,
@@ -26,6 +27,7 @@ __all__ = [
     "Column",
     "Relation",
     "StringDict",
+    "bucket_capacity",
     "empty_relation",
     "from_numpy",
     "to_numpy",

@@ -21,6 +21,7 @@ re-imagination of VEC_DISCRETE + cs_encoding dict encoding
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -30,8 +31,35 @@ import numpy as np
 
 from oceanbase_tpu.datatypes import SqlType, TypeKind
 
+# ---------------------------------------------------------------------------
+# capacity bucket ladder (the static-shape policy)
+# ---------------------------------------------------------------------------
 
-@dataclass(frozen=True, eq=False)  # identity hash: same dict object == same encoding
+DEFAULT_BUCKET_FLOOR = 64
+DEFAULT_BUCKET_GROWTH = 2.0
+
+
+def bucket_capacity(n: int, floor: int = DEFAULT_BUCKET_FLOOR,
+                    growth: float = DEFAULT_BUCKET_GROWTH) -> int:
+    """Smallest ladder capacity >= ``n``.
+
+    The ladder is geometric: ``floor, floor*g, floor*g^2, ...`` — so a
+    relation growing row-by-row passes through O(log n) distinct
+    capacities instead of O(n).  Every consumer of padded relations
+    (aggregates, joins, sorts) is mask-aware, which makes the dead pad
+    lanes invisible; what the ladder buys is XLA executable reuse:
+    ``jax.jit`` retraces per input *shape*, so two snapshots inside one
+    bucket share a compiled plan.
+    """
+    cap = max(int(floor), 1)
+    n = max(int(n), 1)
+    g = max(float(growth), 1.125)  # guard against a degenerate ladder
+    while cap < n:
+        cap = max(cap + 1, int(math.ceil(cap * g)))
+    return cap
+
+
+@dataclass(frozen=True, eq=False)  # content hash via digest (see below)
 class StringDict:
     """Order-preserving dictionary for one string column.
 
@@ -39,12 +67,45 @@ class StringDict:
     stores int32 codes indexing it.  Code -1 is reserved for NULL payloads
     (the validity array is authoritative; -1 just keeps gathers in range
     after clamping).
+
+    Equality/hash are CONTENT-based (a lazily cached digest of the sorted
+    values): two materializations of the same table produce distinct dict
+    objects with identical encodings, and jit keys compiled executables on
+    pytree aux data via ``__eq__`` — identity semantics would force a
+    retrace per materialization even when nothing changed.  Trace-time
+    host translations bake in ``values``, so equal content implies
+    identical traced behavior.
     """
 
     values: np.ndarray  # dtype=object or <U*, sorted ascending
 
     def __post_init__(self):
         assert self.values.ndim == 1
+
+    def _content_digest(self) -> int:
+        d = self.__dict__.get("_digest")
+        if d is None:
+            import hashlib
+
+            a = self.values
+            u = a.astype("U") if a.dtype == object else np.ascontiguousarray(a)
+            h = hashlib.blake2b(digest_size=8)
+            h.update(str(u.dtype).encode())
+            h.update(u.tobytes())
+            d = int.from_bytes(h.digest(), "little")
+            object.__setattr__(self, "_digest", d)
+        return d
+
+    def __hash__(self):
+        return self._content_digest()
+
+    def __eq__(self, other):
+        if self is other:
+            return True
+        if not isinstance(other, StringDict):
+            return NotImplemented
+        return (self.values.shape == other.values.shape
+                and self._content_digest() == other._content_digest())
 
     @property
     def size(self) -> int:
@@ -127,6 +188,25 @@ class Column:
             valid = jnp.take(self.valid, idx, axis=0, mode="clip")
         return self.with_data(data, valid)
 
+    def pad_to(self, capacity: int) -> "Column":
+        """Extend to ``capacity`` rows with dead lanes (zero payload —
+        in-range code 0 for dictionary-encoded strings — and invalid
+        when a validity array exists).  Liveness is the Relation mask's
+        concern; the StringDict is shared unchanged."""
+        n = self.data.shape[0]
+        if capacity <= n:
+            return self
+        pad = capacity - n
+        zeros = jnp.zeros((pad,) + self.data.shape[1:],
+                          dtype=self.data.dtype)
+        data = jnp.concatenate([self.data, zeros])
+        valid = None
+        if self.valid is not None:
+            valid = jnp.concatenate(
+                [self.valid, jnp.zeros(pad, dtype=jnp.bool_)])
+        return Column(data=data, valid=valid, dtype=self.dtype,
+                      sdict=self.sdict)
+
 
 @jax.tree_util.register_pytree_node_class
 @dataclass
@@ -183,6 +263,27 @@ class Relation:
     def gather(self, idx, mask=None) -> "Relation":
         return Relation(
             columns={n: c.gather(idx) for n, c in self.columns.items()},
+            mask=mask,
+        )
+
+    def pad_to(self, capacity: int) -> "Relation":
+        """Pad every column to ``capacity`` with the extra lanes dead in
+        the mask.  The mask is ALWAYS materialized (even when no padding
+        is needed): mask=None and mask=array are different pytree
+        structures, and a relation that flips between them as its live
+        count crosses a bucket boundary would retrace compiled plans the
+        bucket ladder exists to preserve."""
+        n = self.capacity
+        if capacity < n:
+            raise ValueError(
+                f"pad_to({capacity}) below current capacity {n}")
+        mask = self.mask_or_true()
+        if capacity > n:
+            mask = jnp.concatenate(
+                [mask, jnp.zeros(capacity - n, dtype=jnp.bool_)])
+        return Relation(
+            columns={nm: c.pad_to(capacity)
+                     for nm, c in self.columns.items()},
             mask=mask,
         )
 

@@ -1,0 +1,121 @@
+"""Compile-amortization micro-bench: XLA compiles per query over a
+growing table, shape buckets on vs off.
+
+The whole-plan compiler caches jitted executables by plan fingerprint,
+but ``jax.jit`` retraces per input *shape* — so without capacity
+bucketing every INSERT that changes a table's cardinality invalidates
+every compiled plan that touches it.  This bench runs the canonical
+OLTP-interleaved loop (INSERT a batch -> run the same SELECT) ``STEPS``
+times against two fresh databases — one with ``enable_shape_buckets``
+on (the default), one with it off — and reports the XLA trace counts
+from ``gv$plan_cache``.
+
+Target: O(log n) compiles with buckets vs O(n) without (>= 10x fewer on
+a 100-step loop), with identical query results.
+
+Prints ONE JSON line (same harness family as scripts/dtl_bench.py):
+
+    python scripts/recompile_bench.py            # STEPS=100 by default
+    BENCH_STEPS=30 BENCH_ROWS_PER_STEP=20 python scripts/recompile_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+QUERY = ("select grp, sum(v), count(*), avg(v) from t"
+         " group by grp order by grp")
+
+
+def run_loop(root: str, steps: int, rows_per_step: int,
+             buckets: bool):
+    """-> (trace_count, execution_count, results, elapsed_s,
+    plan_cache_rows)."""
+    from oceanbase_tpu.exec import plan as ep
+    from oceanbase_tpu.server import Database
+
+    ep.reset_plan_cache_stats()
+    db = Database(root)
+    s = db.session()
+    s.execute(f"alter system set enable_shape_buckets = "
+              f"{'true' if buckets else 'false'}")
+    s.execute("create table t (id int primary key, v int, grp int)")
+    results = []
+    t0 = time.time()
+    next_id = 0
+    for _step in range(steps):
+        vals = ", ".join(
+            f"({next_id + i}, {(next_id + i) * 7 % 101}, "
+            f"{(next_id + i) % 5})" for i in range(rows_per_step))
+        next_id += rows_per_step
+        s.execute(f"insert into t values {vals}")
+        results.append(s.execute(QUERY).rows())
+    elapsed = time.time() - t0
+    # snapshot the python-side counters BEFORE the gv$plan_cache query
+    # itself executes a plan; the virtual table materializes its rows
+    # from the same pre-execution snapshot, so the two must agree
+    traces = sum(e.xla_traces for e in ep.plan_cache_stats())
+    execs = sum(e.executions for e in ep.plan_cache_stats())
+    r = s.execute("select plan_text, executions, hit_count,"
+                  " xla_trace_count from gv$plan_cache"
+                  " order by executions desc")
+    vt_rows = r.rows()
+    vt_traces = sum(int(x[3]) for x in vt_rows)
+    vt_execs = sum(int(x[1]) for x in vt_rows)
+    db.close()
+    assert vt_traces == traces and vt_execs == execs, \
+        f"gv$plan_cache mismatch: {vt_traces}/{vt_execs} " \
+        f"vs {traces}/{execs}"
+    return traces, execs, results, elapsed, vt_rows
+
+
+def main():
+    steps = int(os.environ.get("BENCH_STEPS", "100"))
+    rows_per_step = int(os.environ.get("BENCH_ROWS_PER_STEP", "50"))
+    root = tempfile.mkdtemp(prefix="recompile_bench_")
+    try:
+        ex_traces, ex_execs, ex_res, ex_s, _ = run_loop(
+            os.path.join(root, "exact"), steps, rows_per_step,
+            buckets=False)
+        bk_traces, bk_execs, bk_res, bk_s, _ = run_loop(
+            os.path.join(root, "bucketed"), steps, rows_per_step,
+            buckets=True)
+        match = ex_res == bk_res
+        print(json.dumps({
+            "metric": "recompile_amortization",
+            "steps": steps,
+            "rows_per_step": rows_per_step,
+            "final_rows": steps * rows_per_step,
+            "compiles_exact": ex_traces,
+            "compiles_bucketed": bk_traces,
+            "compile_ratio": round(ex_traces / max(bk_traces, 1), 2),
+            "executions_exact": ex_execs,
+            "executions_bucketed": bk_execs,
+            "loop_s_exact": round(ex_s, 3),
+            "loop_s_bucketed": round(bk_s, 3),
+            "results_match": bool(match),
+        }))
+        if not match:
+            raise SystemExit("bucketed results diverge from exact")
+        # the >=10x gate is defined for the 100-step acceptance loop;
+        # shorter smoke runs touch fewer buckets and naturally sit lower
+        if steps >= 100 and ex_traces < 10 * bk_traces:
+            raise SystemExit(
+                f"compile amortization below 10x: {ex_traces} exact vs "
+                f"{bk_traces} bucketed")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

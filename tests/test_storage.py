@@ -163,4 +163,7 @@ def test_storage_catalog_executor_integration(tmp_path):
     ts.tablet.write((100,), "insert", {"k": 100, "v": 1000}, tx_id=9)
     ts.tablet.commit(9, 99, [(100,)])
     rel2 = cat.table_data("t")
-    assert rel2.capacity == 51
+    # capacity is bucket-padded (static-shape policy); the LIVE count
+    # reflects the new row
+    assert int(np.asarray(rel2.mask_or_true()).sum()) == 51
+    assert rel2.capacity >= 51
