@@ -1,0 +1,54 @@
+"""obcheck: static analysis enforcing the engine's silent invariants.
+
+PR 2 (shape buckets) made correctness rest on two contracts nothing
+enforced: a masked-dead pad lane must never influence a result, and a
+jitted operator body must never break trace stability (host syncs,
+identity-hashed cache keys, Python branches on tracers).  TVM and Tensor
+Processing Primitives (PAPERS.md) both push kernel contracts into
+compiler-side verification; this package is that layer for the TPU
+build.
+
+Three AST checkers plus one dynamic verifier:
+
+- ``trace_safety``     — host syncs / retrace hazards in jit-reachable
+                         code (rules ``trace.*``);
+- ``mask_discipline``  — every operator that reads Relation/Column data
+                         consumes or propagates ``mask`` (rules
+                         ``mask.*``);
+- ``lock_order``       — lock-acquisition graph inversions and shared-
+                         dict mutation outside any held lock (rules
+                         ``lock.*``);
+- ``poison``           — the executable half: fill pad lanes with
+                         NaN/sentinel garbage and assert bit-identical
+                         results.
+
+Audited exceptions carry a ``# obcheck: ok(<rule>)`` pragma; everything
+else diffs against the checked-in baseline (``analysis/baseline.json``)
+so only NEW violations fail CI.  Driver: ``scripts/obcheck.py``.
+"""
+
+from oceanbase_tpu.analysis.core import (
+    Analyzer,
+    Finding,
+    diff_findings,
+    load_baseline,
+    load_package_files,
+    run_all,
+    write_baseline,
+)
+from oceanbase_tpu.analysis.lock_order import check_lock_order
+from oceanbase_tpu.analysis.mask_discipline import check_mask_discipline
+from oceanbase_tpu.analysis.trace_safety import check_trace_safety
+
+__all__ = [
+    "Analyzer",
+    "Finding",
+    "check_lock_order",
+    "check_mask_discipline",
+    "check_trace_safety",
+    "diff_findings",
+    "load_baseline",
+    "load_package_files",
+    "run_all",
+    "write_baseline",
+]

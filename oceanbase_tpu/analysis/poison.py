@@ -1,0 +1,102 @@
+"""Poison-lane verifier: the Static-shape policy as an executable check.
+
+Every operator must treat masked-dead pad lanes as if they did not
+exist.  The static half of that contract is ``mask_discipline``; this is
+the dynamic half: fill the dead lanes of a relation with adversarial
+garbage — NaN payloads in float columns, a loud bit pattern in int
+columns, out-of-range codes in string columns, and (worst case) validity
+bits flipped to True — then re-run the query and require *bit-identical*
+results.  A pad lane that influences anything shows up as a diff.
+
+Poison values are deliberately hostile:
+
+- float    -> NaN (breaks any unmasked arithmetic/compare)
+- int      -> 0x5AD5AD5AD5AD5AD5-ish sentinel (breaks unmasked sums)
+- bool     -> True (breaks unmasked counts)
+- strings  -> code -1 (the reserved NULL payload; must stay clamped)
+- valid    -> True on dead lanes (operators must gate on mask, not
+              validity)
+
+Use ``poison_pad_lanes`` on one relation, ``poison_tables`` on a plan's
+input dict, or ``assert_poison_invariant`` to run the whole
+clean-vs-poisoned comparison.  Tests get these via the ``poison``
+fixture in ``tests/conftest.py``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from oceanbase_tpu.datatypes import TypeKind
+from oceanbase_tpu.vector.column import Column, Relation
+
+INT_POISON = np.int64(0x5AD5AD5AD5AD5AD)  # loud, sign-safe bit pattern
+
+
+def _poison_data(data, dead, dtype_kind):
+    if dtype_kind == TypeKind.STRING:
+        return jnp.where(dead, jnp.asarray(-1, data.dtype), data)
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        if data.ndim == 2:  # vector columns: poison whole rows
+            return jnp.where(dead[:, None], jnp.nan, data)
+        return jnp.where(dead, jnp.asarray(jnp.nan, data.dtype), data)
+    if data.dtype == jnp.bool_:
+        return jnp.where(dead, True, data)
+    return jnp.where(dead, jnp.asarray(INT_POISON, data.dtype), data)
+
+
+def poison_pad_lanes(rel: Relation) -> Relation:
+    """Fill masked-dead lanes with adversarial garbage (payload AND
+    validity).  A relation with no dead lanes returns equivalent data."""
+    mask = rel.mask_or_true()
+    dead = ~mask
+    cols = {}
+    for name, c in rel.columns.items():
+        data = _poison_data(c.data, dead, c.dtype.kind)
+        valid = c.valid
+        if valid is not None:
+            # dead lanes become "valid": only the mask may save us
+            valid = jnp.where(dead, True, valid)
+        cols[name] = Column(data, valid, c.dtype, c.sdict)
+    return Relation(columns=cols, mask=mask)
+
+
+def poison_tables(tables: dict) -> dict:
+    return {name: poison_pad_lanes(rel) for name, rel in tables.items()}
+
+
+def results_identical(a: dict, b: dict) -> tuple[bool, str]:
+    """Bit-identical comparison of two ``to_numpy`` result dicts.
+    Returns (ok, first difference description)."""
+    if sorted(a) != sorted(b):
+        return False, f"column sets differ: {sorted(a)} vs {sorted(b)}"
+    for k in sorted(a):
+        x, y = np.asarray(a[k]), np.asarray(b[k])
+        if x.shape != y.shape:
+            return False, f"{k}: shape {x.shape} vs {y.shape}"
+        if x.dtype == object or y.dtype == object:
+            if list(map(repr, x.reshape(-1))) != \
+                    list(map(repr, y.reshape(-1))):
+                return False, f"{k}: object values differ"
+            continue
+        # bit-level equality: NaN == NaN, -0.0 != 0.0
+        if x.tobytes() != y.tobytes():
+            return False, f"{k}: payload bits differ"
+    return True, ""
+
+
+def assert_poison_invariant(run, tables: dict, materialize=None) -> None:
+    """Run ``run(tables)`` clean and poisoned; assert bit-identical
+    results.  ``run`` maps {name: Relation} -> Relation (e.g. a bound
+    ``execute_plan``); ``materialize`` overrides the host conversion
+    (defaults to ``vector.to_numpy``)."""
+    from oceanbase_tpu.vector import to_numpy
+
+    mat = materialize or to_numpy
+    clean = mat(run(tables))
+    poisoned = mat(run(poison_tables(tables)))
+    ok, why = results_identical(clean, poisoned)
+    assert ok, (
+        f"poison-lane invariant violated: {why} — a masked-dead pad "
+        f"lane influenced the result (Static-shape policy, ROADMAP)")

@@ -326,7 +326,15 @@ def _compiled(plan_key, plan_holder, with_monitor=False):
                 mvals = []
         diag_names.clear()
         diag_names.extend(n for n, _ in entries)
-        return out, [v for _, v in entries], mvals
+        # fold the per-operator overflow lanes into ONE scalar on device:
+        # the per-execute host check reads a single value instead of
+        # syncing once per diagnostic lane (obcheck trace.host-sync)
+        import jax.numpy as jnp
+
+        total = jnp.zeros((), dtype=jnp.int64)
+        for _n, v in entries:
+            total = total + jnp.maximum(jnp.asarray(v, dtype=jnp.int64), 0)
+        return out, [v for _, v in entries], total, mvals
 
     # the stats object rides along with the compiled entry: the closure
     # above increments THIS object at trace time, so callers must count
@@ -370,17 +378,22 @@ def execute_plan(plan: PlanNode, tables: dict[str, Relation],
         key, _PlanHolder(plan, key), with_monitor)
     traces_before = stats.xla_traces
     t0 = time.perf_counter()
-    out, diag_vals, mon_vals = run(
+    out, diag_vals, diag_total, mon_vals = run(
         {k: v for k, v in tables.items() if k in needed})
     stats.executions += 1
     if stats.xla_traces > traces_before:
         stats.last_compile_s = time.perf_counter() - t0
     if with_monitor:
-        monitor_out.extend(
+        # audited: opt-in plan-monitor collection materializes per-op row
+        # counts; only runs when enable_sql_plan_monitor is set
+        monitor_out.extend(  # obcheck: ok(trace.host-sync)
             (n, int(v)) for n, v in zip(monitor_names, mon_vals))
     if check_overflow and diag_vals:
-        vals = [int(v) for v in diag_vals]
-        if any(v > 0 for v in vals):
+        # audited result-boundary sync: ONE host read decides validity;
+        # the per-lane detail below only materializes on the error path
+        total = int(diag_total)  # obcheck: ok(trace.host-sync)
+        if total > 0:
+            vals = [int(v) for v in diag_vals]  # obcheck: ok(trace.host-sync)
             detail = ", ".join(
                 f"{n}={v}" for n, v in zip(diag_names, vals) if v > 0
             )

@@ -116,10 +116,10 @@ def _one_window(rel: Relation, wc: ir.WindowCall) -> Column:
             f"window {fn} offset must be an integer literal")
 
     if fn == "ntile":
-        b = _lit_int((wc.extra or [None])[0], None)
-        if not b or b < 1:
+        buckets = _lit_int((wc.extra or [None])[0], None)
+        if not buckets or buckets < 1:
             raise NotImplementedError("ntile needs a positive bucket count")
-        q, r = psize // b, psize % b
+        q, r = psize // buckets, psize % buckets
         j = pos_in_part
         big = r * (q + 1)
         res = jnp.where(j < big, j // jnp.maximum(q + 1, 1),

@@ -256,8 +256,14 @@ class NodeServer:
 
         s = self._sessions.get(session_id)
         if s is None:
-            s = Session(self.catalog, tenant=self.tenant, db=self.db)
-            self._sessions[session_id] = s
+            # concurrent wire threads race the check-then-insert; the
+            # apply lock makes one session per id authoritative
+            with self._apply_lock:
+                s = self._sessions.get(session_id)
+                if s is None:
+                    s = Session(self.catalog, tenant=self.tenant,
+                                db=self.db)
+                    self._sessions[session_id] = s
         return s
 
     @staticmethod
@@ -334,7 +340,12 @@ class NodeServer:
 
         if node_id is None:
             node_id = self.location.home_of(table)
-        cli = self.peers[node_id]
+        cli = self.peers.get(node_id)
+        if cli is None:
+            # the table's home is this node (or unknown): serve the
+            # local snapshot through the same handler instead of a
+            # KeyError masquerading as an RpcError
+            return self._local_table_pages(table, snapshot, stats)
         chunks = []
         snap, off, nbytes = snapshot, 0, 0
         t0 = _time.time()
@@ -365,6 +376,29 @@ class NodeServer:
                 pushdown_hit=False, bytes_shipped=nbytes,
                 rows_shipped=chunks[0]["total"],
                 elapsed_s=_time.time() - t0))
+        return arrays, valids, chunks[0]["types"], snap
+
+    def _local_table_pages(self, table: str, snapshot: int | None,
+                           stats: dict | None):
+        """fetch_remote_table's local twin: page the snapshot through
+        the same das.scan handler (zero wire bytes)."""
+        chunks, snap, off = [], snapshot, 0
+        while True:
+            r = self._h_scan(table, snapshot=snap, offset=off,
+                             limit=SCAN_CHUNK_ROWS)
+            snap = r["snapshot"]
+            chunks.append(r)
+            off += SCAN_CHUNK_ROWS
+            if off >= r["total"]:
+                break
+        arrays, valids = {}, {}
+        for k in chunks[0]["arrays"]:
+            arrays[k] = np.concatenate([c["arrays"][k] for c in chunks])
+        for k in chunks[0].get("valids", {}):
+            valids[k] = np.concatenate([c["valids"][k] for c in chunks])
+        if stats is not None:
+            stats["bytes"] = 0
+            stats["rows"] = chunks[0]["total"]
         return arrays, valids, chunks[0]["types"], snap
 
     # ------------------------------------------------------------------

@@ -31,6 +31,7 @@ from oceanbase_tpu.px.exchange import (
     all_to_all_repartition,
     broadcast_gather,
     exchange_by_dest,
+    shard_map_compat,
     shard_relation,
     unshard_relation,
 )
@@ -126,20 +127,23 @@ def dist_groupby(
 
     spec = P(axis)
     run = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             fn, mesh=mesh, in_specs=(spec,), out_specs=(spec, P()),
-            check_vma=False,
         )
     )
     out, overflow = run(sharded)
-    if int(overflow) > 0:
+    # enqueue the gather before the overflow check: the host sync on the
+    # count then overlaps the device-side unshard instead of gating it
+    rel = unshard_relation(out)
+    n_over = int(overflow)  # obcheck: ok(trace.host-sync)
+    if n_over > 0:
         from oceanbase_tpu.exec.diag import CapacityOverflow
 
         raise CapacityOverflow(
-            f"exchange buffer overflow: {int(overflow)} rows dropped; "
+            f"exchange buffer overflow: {n_over} rows dropped; "
             f"increase local_cap"
         )
-    return unshard_relation(out)
+    return rel
 
 
 _HOT_SENTINEL = np.iinfo(np.int64).max

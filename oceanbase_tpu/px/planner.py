@@ -49,6 +49,7 @@ from oceanbase_tpu.px.dist_ops import (
 from oceanbase_tpu.px.exchange import (
     broadcast_gather,
     default_mesh,
+    shard_map_compat,
     shard_relation,
     shard_relation_by_hash,
     unshard_relation,
@@ -599,11 +600,10 @@ def _px_compiled(plan_key, holder, mesh, axis, ndev, factor, table_names):
                 total_ovf = total_ovf + jnp.asarray(v, dtype=jnp.int64)
         return rel, jax.lax.psum(total_ovf, axis)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         shard_body, mesh=mesh,
         in_specs=({t: P(axis) for t in table_names},),
         out_specs=(P(axis), P()),
-        check_vma=False,
     ))
 
 
@@ -658,9 +658,11 @@ def execute_plan_distributed(plan: pp.PlanNode, tables: dict,
         _Holder(droot, partial_specs, elide, dist_sort, cache_key),
         mesh, axis, ndev, budget_factor, tuple(sorted(needed)))
     out, overflow = run(sharded)
-    if int(overflow) > 0:
-        raise diag.CapacityOverflow(
-            f"PX exchange overflow: {int(overflow)} rows dropped")
+    # do NOT sync on the overflow scalar here: an int() at this point
+    # parks the host mid-pipeline while the gather/merge/top-chain work
+    # below could already be enqueued behind the shard program.  The
+    # count rides along as a device scalar and is checked exactly once
+    # at the result boundary.
     rel = unshard_relation(out)
 
     if scalar_agg is not None:
@@ -676,4 +678,11 @@ def execute_plan_distributed(plan: pp.PlanNode, tables: dict,
             rel = ops.limit(rel, node.k, node.offset)
         elif isinstance(node, pp.Project):
             rel = ops.project(rel, node.outputs)
+
+    # audited result-boundary sync: the one host read that decides
+    # whether the (fully enqueued) result is valid or must be re-planned
+    n_over = int(overflow)  # obcheck: ok(trace.host-sync)
+    if n_over > 0:
+        raise diag.CapacityOverflow(
+            f"PX exchange overflow: {n_over} rows dropped")
     return rel

@@ -21,7 +21,12 @@ def ensure_server_credentials(root: str) -> tuple[str, str]:
     if os.path.exists(cert_p) and os.path.exists(key_p):
         return cert_p, key_p
     os.makedirs(tdir, exist_ok=True)
-    from cryptography import x509
+    try:
+        from cryptography import x509
+    except ImportError:
+        # minimal images ship no cryptography wheel; the openssl binary
+        # generates an equivalent self-signed pair
+        return _openssl_credentials(tdir, cert_p, key_p)
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
     from cryptography.x509.oid import NameOID
@@ -47,6 +52,32 @@ def ensure_server_credentials(root: str) -> tuple[str, str]:
     with open(cert_p, "wb") as fh:
         fh.write(cert.public_bytes(serialization.Encoding.PEM))
     return cert_p, key_p
+
+
+def _openssl_credentials(tdir: str, cert_p: str, key_p: str
+                         ) -> tuple[str, str]:
+    """Self-signed pair via the openssl CLI (fallback when the
+    ``cryptography`` module is unavailable)."""
+    import shutil
+    import subprocess
+
+    exe = shutil.which("openssl")
+    if exe is None:
+        raise RuntimeError(
+            "TLS credentials need either the 'cryptography' module or "
+            "an openssl binary; neither is available")
+    base = [exe, "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", key_p, "-out", cert_p, "-days", "3650",
+            "-subj", "/CN=oceanbase-tpu"]
+    # -addext needs OpenSSL >= 1.1.1; LibreSSL/older builds still make a
+    # usable self-signed pair without the SAN
+    for cmd in (base + ["-addext", "subjectAltName=DNS:localhost"], base):
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode == 0:
+            return cert_p, key_p
+    raise RuntimeError(
+        f"openssl self-signed certificate generation failed: "
+        f"{r.stderr.strip()[:500]}")
 
 
 def server_context(root: str) -> ssl.SSLContext:

@@ -153,6 +153,12 @@ class StorageEngine:
                         self._replay(json.loads(line))
 
     def _replay(self, op: dict):
+        # boot-time today, but WAL catch-up may replay on a live engine;
+        # holding the (reentrant) engine lock makes either safe
+        with self._lock:
+            self._replay_locked(op)
+
+    def _replay_locked(self, op: dict):
         kind = op["op"]
         if kind == "create_table":
             cols = [ColumnDef(n, SqlType(TypeKind(k), p, s), nl)
@@ -235,6 +241,10 @@ class StorageEngine:
     # DDL / load
     # ------------------------------------------------------------------
     def _install_table(self, tdef: TableDef, log=True):
+        with self._lock:  # reentrant: callers may already hold it
+            self._install_table_locked(tdef, log)
+
+    def _install_table_locked(self, tdef: TableDef, log=True):
         types = {c.name: c.dtype for c in tdef.columns}
         columns = list(tdef.column_names)
         key_cols = list(tdef.primary_key)

@@ -279,14 +279,18 @@ class Tablet:
 
     # -- segment management hooks (shared with PartitionedTablet) --------
     def add_segment(self, seg, part_idx=None):
-        self.segments.append(seg)
-        self.data_version += 1
+        # segment list + data_version guard reads through THIS tablet's
+        # lock; callers under the engine lock still must not bypass it
+        with self._lock:
+            self.segments.append(seg)
+            self.data_version += 1
 
     def remove_segments(self, ids):
         ids = set(ids)
-        self.segments = [s for s in self.segments
-                         if s.segment_id not in ids]
-        self.data_version += 1
+        with self._lock:
+            self.segments = [s for s in self.segments
+                             if s.segment_id not in ids]
+            self.data_version += 1
 
     def segment_locations(self):
         """-> [(Segment, partition_idx|None)] for manifest checkpoints."""

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""obcheck driver: trace-safety, mask-discipline, lock-order analysis.
+
+    python scripts/obcheck.py                  # full report, exit 0
+    python scripts/obcheck.py --ci             # fail (exit 1) on NEW
+                                               # findings vs the baseline
+    python scripts/obcheck.py --ci --json      # one-line JSON summary
+                                               # (dtl_bench-style)
+    python scripts/obcheck.py --write-baseline # refresh the baseline
+
+The baseline (oceanbase_tpu/analysis/baseline.json) is a multiset of
+finding keys: pre-existing, audited findings land green in CI and only
+new violations fail.  Audited single sites prefer an inline
+``# obcheck: ok(<rule>)`` pragma over a baseline entry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from collections import Counter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# The checkers are pure stdlib AST analysis; pre-registering the parent
+# package skips oceanbase_tpu/__init__ (which imports jax — seconds of
+# cold start the CLI never needs)
+if "oceanbase_tpu" not in sys.modules:
+    import types
+
+    _pkg = types.ModuleType("oceanbase_tpu")
+    _pkg.__path__ = [os.path.join(REPO, "oceanbase_tpu")]
+    sys.modules["oceanbase_tpu"] = _pkg
+
+from oceanbase_tpu.analysis import (  # noqa: E402
+    core,
+    diff_findings,
+    load_baseline,
+    load_package_files,
+    run_all,
+    write_baseline,
+)
+from oceanbase_tpu.analysis.lock_order import check_lock_order  # noqa: E402
+from oceanbase_tpu.analysis.mask_discipline import (  # noqa: E402
+    check_mask_discipline,
+)
+from oceanbase_tpu.analysis.trace_safety import check_trace_safety  # noqa: E402
+
+CHECKERS = {
+    "trace": check_trace_safety,
+    "mask": check_mask_discipline,
+    "lock": check_lock_order,
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ci", action="store_true",
+                    help="diff against the baseline; exit 1 on new findings")
+    ap.add_argument("--json", action="store_true",
+                    help="emit a one-line JSON summary")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings as the new baseline")
+    ap.add_argument("--root", default=REPO, help="repo root to scan")
+    ap.add_argument("--baseline", default=core.BASELINE_PATH,
+                    help="baseline file path")
+    ap.add_argument("--rules", default="trace,mask,lock",
+                    help="comma-separated rule families to run")
+    args = ap.parse_args(argv)
+
+    t0 = time.time()
+    files = load_package_files(args.root)
+    selected = [r.strip() for r in args.rules.split(",")
+                if r.strip() in CHECKERS]
+    if args.write_baseline and set(selected) != set(CHECKERS):
+        # a partial run must never overwrite the other families' entries
+        print("obcheck: --write-baseline requires all rule families "
+              "(drop --rules)", file=sys.stderr)
+        return 2
+    checkers = [CHECKERS[r] for r in selected]
+    findings = run_all(files, checkers)
+    baseline = load_baseline(args.baseline) if not args.write_baseline \
+        else Counter()
+    new = diff_findings(findings, baseline)
+
+    if args.write_baseline:
+        data = write_baseline(findings, args.baseline)
+        print(f"baseline written: {data['total']} findings -> "
+              f"{args.baseline}")
+        return 0
+
+    by_rule = Counter(f.rule for f in findings)
+    if args.json:
+        print(json.dumps({
+            "metric": "obcheck",
+            "files": len(files),
+            "findings": len(findings),
+            "new": len(new),
+            "baselined": len(findings) - len(new),
+            "by_rule": {k: by_rule[k] for k in sorted(by_rule)},
+            "duration_s": round(time.time() - t0, 3),
+        }))
+    if not args.json or new:
+        report = new if args.ci else findings
+        for f in report:
+            print(f.render(), file=sys.stderr if args.ci else sys.stdout)
+    if not args.json and not args.ci:
+        print(f"{len(findings)} findings ({len(new)} new, "
+              f"{len(findings) - len(new)} baselined) across "
+              f"{len(files)} files")
+    if args.ci and new:
+        print(f"obcheck: {len(new)} NEW finding(s); fix them, add an "
+              f"audited '# obcheck: ok(<rule>)' pragma, or refresh the "
+              f"baseline via --write-baseline", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

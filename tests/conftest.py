@@ -35,3 +35,46 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+def rewrite_outer_join_for_old_sqlite(sql: str, left: str, right: str,
+                                      left_cols, right_cols) -> str:
+    """RIGHT/FULL OUTER JOIN oracle queries for pre-3.39 sqlite: right
+    join becomes the swapped left join; full outer becomes a left join
+    UNION ALL the unmatched build rows (detected via a rowid probe).
+    WHERE/GROUP BY/ORDER BY tails stay outside the rewritten join, which
+    preserves their post-join semantics.  No-op on sqlite >= 3.39."""
+    import re
+    import sqlite3
+
+    if sqlite3.sqlite_version_info >= (3, 39):
+        return sql
+    m = re.search(
+        rf"from {left} (full outer|right outer|right) join {right} on "
+        rf"(.+?)(?= where| order by| group by|$)", sql)
+    if m is None:
+        return sql
+    kind, cond = m.group(1), m.group(2).strip()
+    if kind in ("right", "right outer"):
+        repl = f"from {right} left join {left} on {cond}"
+    else:
+        exposed = ", ".join(
+            [f"{left}.{c} as {c}" for c in left_cols]
+            + [f"{right}.{c} as {c}" for c in right_cols])
+        plain = ", ".join(
+            [f"{left}.{c}" for c in left_cols]
+            + [f"{right}.{c}" for c in right_cols])
+        repl = (f"from (select {exposed} from {left} left join {right} "
+                f"on {cond} union all select {plain} from {right} left "
+                f"join {left} on {cond} where {left}.rowid is null)")
+    return sql.replace(m.group(0), repl)
+
+
+@pytest.fixture()
+def poison():
+    """Poison-lane verifier (oceanbase_tpu.analysis.poison): fills
+    masked-dead pad lanes with NaN/sentinel garbage so a query result
+    that changes proves an operator read a dead lane."""
+    from oceanbase_tpu.analysis import poison as _p
+
+    return _p

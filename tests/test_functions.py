@@ -20,6 +20,13 @@ def env():
     s.catalog.load_numpy("t", {"a": a, "f": f, "w": words})
     conn = sqlite3.connect(":memory:")
     conn.create_function("ln", 1, math.log)
+    # sign()/mod() are native only from sqlite 3.35; UDFs keep old oracles
+    conn.create_function(
+        "sign", 1,
+        lambda x: None if x is None else (x > 0) - (x < 0))
+    conn.create_function(
+        "mod", 2,
+        lambda x, y: None if x is None or y is None else math.fmod(x, y))
     conn.execute("create table t (a, f, w)")
     conn.executemany("insert into t values (?,?,?)",
                      list(zip(a.tolist(), f.tolist(), words.tolist())))

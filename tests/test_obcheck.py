@@ -1,0 +1,421 @@
+"""obcheck static-analysis suite tests.
+
+Each checker must (a) catch its seeded violation fixture, (b) stay quiet
+on the clean twin, (c) honor ``# obcheck: ok(<rule>)`` pragmas, and
+(d) report only NEW findings against a baseline.  The final test is the
+tier-1 CI gate: the shipped tree diffed against the shipped baseline
+must be clean — introducing a host sync, mask drop, or lock inversion
+anywhere in the package fails the suite here.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.analysis import (
+    Analyzer,
+    diff_findings,
+    load_baseline,
+    load_package_files,
+    run_all,
+    write_baseline,
+)
+from oceanbase_tpu.analysis.lock_order import check_lock_order
+from oceanbase_tpu.analysis.mask_discipline import check_mask_discipline
+from oceanbase_tpu.analysis.trace_safety import check_trace_safety
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# trace-safety
+# ---------------------------------------------------------------------------
+
+TRACED_BAD = '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def body(x):
+    s = jnp.sum(x)
+    n = int(s)
+    if s > 0:
+        return s
+    return s * n
+'''
+
+HOST_BAD = '''
+import jax
+import jax.numpy as jnp
+
+def factory():
+    def f(x):
+        return jnp.sum(x), jnp.max(x)
+    return jax.jit(f)
+
+def driver(x):
+    run = factory()
+    out, ovf = run(x)
+    if int(ovf) > 0:
+        raise RuntimeError("overflow")
+    return out
+'''
+
+TRACED_CLEAN = '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def body(x):
+    s = jnp.sum(x)
+    k = int(x.shape[0])  # static metadata: fine
+    if k > 4:            # python int: fine
+        return s * 2
+    return s
+'''
+
+CACHE_BAD = '''
+import functools
+
+class Holder:
+    def __init__(self, plan):
+        self.plan = plan
+
+@functools.lru_cache(maxsize=8)
+def compile_plan(holder):
+    return holder
+
+def lookup(plan):
+    return compile_plan(Holder(plan))
+'''
+
+CACHE_CLEAN = '''
+import functools
+
+class Holder:
+    def __init__(self, plan, key):
+        self.plan = plan
+        self.key = key
+
+    def __hash__(self):
+        return hash(self.key)
+
+    def __eq__(self, other):
+        return isinstance(other, Holder) and other.key == self.key
+
+@functools.lru_cache(maxsize=8)
+def compile_plan(holder):
+    return holder
+
+def lookup(plan):
+    return compile_plan(Holder(plan, repr(plan)))
+'''
+
+
+def test_trace_safety_catches_traced_host_sync():
+    fs = {"oceanbase_tpu/exec/bad.py": TRACED_BAD}
+    found = run_all(fs, [check_trace_safety])
+    assert "trace.host-sync" in _rules(found)
+    assert "trace.tracer-branch" in _rules(found)
+
+
+def test_trace_safety_catches_post_jit_sync():
+    fs = {"oceanbase_tpu/px/bad.py": HOST_BAD}
+    found = run_all(fs, [check_trace_safety])
+    syncs = [f for f in found if f.rule == "trace.host-sync"]
+    assert syncs and "int(ovf)" in syncs[0].message
+
+
+def test_trace_safety_clean_fixture_passes():
+    fs = {"oceanbase_tpu/exec/good.py": TRACED_CLEAN}
+    assert run_all(fs, [check_trace_safety]) == []
+
+
+def test_cache_key_identity_hash():
+    fs = {"oceanbase_tpu/exec/cache.py": CACHE_BAD}
+    found = run_all(fs, [check_trace_safety])
+    assert "trace.cache-key" in _rules(found)
+    fs = {"oceanbase_tpu/exec/cache.py": CACHE_CLEAN}
+    assert run_all(fs, [check_trace_safety]) == []
+
+
+def test_trace_pragma_suppresses():
+    src = TRACED_BAD.replace(
+        "    n = int(s)",
+        "    n = int(s)  # obcheck: ok(trace.host-sync)").replace(
+        "    if s > 0:",
+        "    # obcheck: ok(trace)\n    if s > 0:")
+    fs = {"oceanbase_tpu/exec/bad.py": src}
+    assert run_all(fs, [check_trace_safety]) == []
+
+
+# ---------------------------------------------------------------------------
+# mask discipline
+# ---------------------------------------------------------------------------
+
+MASK_BAD = '''
+import jax.numpy as jnp
+
+def leaky_total(rel):
+    total = jnp.zeros(())
+    for c in rel.columns.values():
+        total = total + jnp.sum(c.data)
+    return total
+'''
+
+MASK_CLEAN = '''
+import jax.numpy as jnp
+
+def masked_total(rel):
+    m = rel.mask_or_true()
+    total = jnp.zeros(())
+    for c in rel.columns.values():
+        total = total + jnp.sum(jnp.where(m, c.data, 0))
+    return total
+'''
+
+
+def test_mask_discipline_catches_drop():
+    fs = {"oceanbase_tpu/px/leaky.py": MASK_BAD}
+    found = run_all(fs, [check_mask_discipline])
+    assert _rules(found) == ["mask.drop"]
+    # same code outside the operator surface: not under contract
+    fs = {"oceanbase_tpu/share/leaky.py": MASK_BAD}
+    assert run_all(fs, [check_mask_discipline]) == []
+
+
+def test_mask_discipline_clean_and_pragma():
+    fs = {"oceanbase_tpu/px/ok.py": MASK_CLEAN}
+    assert run_all(fs, [check_mask_discipline]) == []
+    sup = MASK_BAD.replace(
+        "def leaky_total(rel):",
+        "def leaky_total(rel):  # obcheck: ok(mask.drop)")
+    fs = {"oceanbase_tpu/px/leaky.py": sup}
+    assert run_all(fs, [check_mask_discipline]) == []
+
+
+def test_mask_registry_hygiene():
+    from oceanbase_tpu.analysis import mask_discipline as md
+
+    # a stale exemption (function handles mask itself) is itself flagged
+    fs = {"oceanbase_tpu/px/ok.py": MASK_CLEAN}
+    old = md.CONTRACTS.get("oceanbase_tpu/px/ok.py")
+    md.CONTRACTS["oceanbase_tpu/px/ok.py"] = {
+        "masked_total": "bogus", "ghost_fn": "gone"}
+    try:
+        found = run_all(fs, [check_mask_discipline])
+    finally:
+        if old is None:
+            del md.CONTRACTS["oceanbase_tpu/px/ok.py"]
+        else:
+            md.CONTRACTS["oceanbase_tpu/px/ok.py"] = old
+    assert _rules(found) == ["mask.stale-exempt", "mask.unknown-exempt"]
+
+
+# ---------------------------------------------------------------------------
+# lock order
+# ---------------------------------------------------------------------------
+
+LOCK_INVERSION = '''
+import threading
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.peer = B()
+
+    def one(self):
+        with self._lock:
+            self.peer.two()
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.owner = A()
+
+    def two(self):
+        with self._lock:
+            return 1
+
+    def back(self):
+        with self._lock:
+            self.owner.one()
+'''
+
+LOCK_CLEAN = '''
+import threading
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.peer = B()
+
+    def one(self):
+        with self._lock:
+            pass
+        self.peer.two()   # lock released before calling out
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def two(self):
+        with self._lock:
+            return 1
+'''
+
+UNLOCKED_MUT = '''
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = {}
+
+    def put(self, k, v):
+        self.items[k] = v
+
+    def get(self, k):
+        with self._lock:
+            return self.items.get(k)
+'''
+
+
+def test_lock_inversion_detected():
+    fs = {"oceanbase_tpu/tx/fixture.py": LOCK_INVERSION}
+    found = run_all(fs, [check_lock_order])
+    inv = [f for f in found if f.rule == "lock.inversion"]
+    assert inv and "A._lock" in inv[0].message and \
+        "B._lock" in inv[0].message
+
+
+def test_lock_clean_passes():
+    fs = {"oceanbase_tpu/tx/fixture.py": LOCK_CLEAN}
+    found = run_all(fs, [check_lock_order])
+    assert [f for f in found if f.rule == "lock.inversion"] == []
+
+
+def test_unlocked_mutation_detected_and_pragma():
+    fs = {"oceanbase_tpu/tx/fixture.py": UNLOCKED_MUT}
+    found = run_all(fs, [check_lock_order])
+    assert _rules(found) == ["lock.unlocked-mut"]
+    sup = UNLOCKED_MUT.replace(
+        "        self.items[k] = v",
+        "        # obcheck: ok(lock.unlocked-mut)\n"
+        "        self.items[k] = v")
+    fs = {"oceanbase_tpu/tx/fixture.py": sup}
+    assert run_all(fs, [check_lock_order]) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline diffing
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_only_reports_new(tmp_path):
+    fs = {"oceanbase_tpu/tx/fixture.py": UNLOCKED_MUT}
+    first = run_all(fs, [check_lock_order])
+    assert first
+    bp = str(tmp_path / "base.json")
+    write_baseline(first, bp)
+    base = load_baseline(bp)
+    assert diff_findings(first, base) == []
+    # a SECOND violation in another method is new, the first stays quiet
+    src = UNLOCKED_MUT + (
+        "\n    def drop(self, k):\n        self.items.pop(k, None)\n")
+    fs = {"oceanbase_tpu/tx/fixture.py": src}
+    second = run_all(fs, [check_lock_order])
+    new = diff_findings(second, base)
+    assert len(new) == 1 and "pop" in new[0].message
+
+
+def test_parse_error_is_a_finding():
+    fs = {"oceanbase_tpu/exec/broken.py": "def f(:\n"}
+    found = run_all(fs, [check_trace_safety])
+    assert [f.rule for f in found] == ["trace.parse-error"]
+
+
+# ---------------------------------------------------------------------------
+# pragma mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_pragma_family_prefix_and_exact():
+    az = Analyzer({"x.py": "a = 1  # obcheck: ok(trace)\n"
+                          "b = 2  # obcheck: ok(mask.drop, lock.inversion)\n"
+                          "c = 3\n"})
+    assert az.suppressed("x.py", 1, "trace.host-sync")
+    assert az.suppressed("x.py", 2, "mask.drop")
+    assert az.suppressed("x.py", 2, "lock.inversion")
+    assert not az.suppressed("x.py", 2, "mask.stale-exempt")
+    # a pragma covers its own line and the one below (decorator/def
+    # idiom), never two lines down
+    assert az.suppressed("x.py", 2, "trace.host-sync")
+    assert az.suppressed("x.py", 3, "mask.drop")
+    assert not az.suppressed("x.py", 3, "trace.host-sync")
+
+
+# ---------------------------------------------------------------------------
+# the CI gate: shipped tree vs shipped baseline
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_clean_vs_baseline():
+    """Tier-1 gate: any new trace/mask/lock violation in the package
+    fails here with the finding's file:line and message."""
+    files = load_package_files(REPO)
+    findings = run_all(files)
+    new = diff_findings(findings, load_baseline())
+    assert not new, "NEW obcheck findings:\n" + "\n".join(
+        f.render() for f in new)
+
+
+def test_cli_ci_gate_end_to_end(tmp_path):
+    """scripts/obcheck.py --ci: green on a clean tree, red once a seeded
+    violation lands, green again after --write-baseline."""
+    root = tmp_path / "mini"
+    pkg = root / "oceanbase_tpu" / "px"
+    pkg.mkdir(parents=True)
+    (pkg / "ok.py").write_text(MASK_CLEAN)
+    bp = str(tmp_path / "base.json")
+    script = os.path.join(REPO, "scripts", "obcheck.py")
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, script, "--root", str(root),
+             "--baseline", bp, *extra],
+            capture_output=True, text=True)
+
+    r = run("--write-baseline")
+    assert r.returncode == 0, r.stderr
+    r = run("--ci", "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    summary = json.loads(r.stdout.splitlines()[0])
+    assert summary["metric"] == "obcheck" and summary["new"] == 0
+
+    # seed all three violation families; each must trip the gate
+    (pkg / "leaky.py").write_text(MASK_BAD)
+    (root / "oceanbase_tpu" / "exec").mkdir()
+    (root / "oceanbase_tpu" / "exec" / "sync.py").write_text(TRACED_BAD)
+    (root / "oceanbase_tpu" / "tx").mkdir()
+    (root / "oceanbase_tpu" / "tx" / "inv.py").write_text(LOCK_INVERSION)
+    r = run("--ci", "--json")
+    assert r.returncode == 1
+    summary = json.loads(r.stdout.splitlines()[0])
+    assert summary["new"] >= 3
+    assert "mask.drop" in r.stderr
+    assert "trace.host-sync" in r.stderr
+    assert "lock.inversion" in r.stderr
+
+    r = run("--write-baseline")
+    assert r.returncode == 0
+    r = run("--ci")
+    assert r.returncode == 0

@@ -10,7 +10,12 @@ import pytest
 from oceanbase_tpu.exec.ops import AggSpec
 from oceanbase_tpu.expr import ir
 from oceanbase_tpu.px.dist_ops import dist_groupby, dist_join_shard
-from oceanbase_tpu.px.exchange import default_mesh, shard_relation, unshard_relation
+from oceanbase_tpu.px.exchange import (
+    default_mesh,
+    shard_map_compat,
+    shard_relation,
+    unshard_relation,
+)
 from oceanbase_tpu.vector import from_numpy, to_numpy
 
 
@@ -62,9 +67,8 @@ def test_dist_join_matches_local(rng, mesh):
             ndev=8, cap_per_dest=nl // 4, out_capacity=nl, how="inner")
         return out, jax.lax.psum(local_ovf, "px")
 
-    run = jax.jit(jax.shard_map(
+    run = jax.jit(shard_map_compat(
         fn, mesh=mesh, in_specs=(P("px"), P("px")), out_specs=(P("px"), P()),
-        check_vma=False,
     ))
     shard_out, overflow = run(ls, rs)
     assert int(overflow) == 0

@@ -132,6 +132,37 @@ def test_padded_lanes_invisible(name, fn):
     assert a == b
 
 
+@pytest.mark.parametrize("name,fn", CASES, ids=[c[0] for c in CASES])
+def test_poisoned_padded_lanes_invisible(name, fn, poison):
+    """The poison-lane verifier over the parity suite: adversarial
+    garbage in pad lanes (NaN payloads, sentinel ints, validity flipped
+    true) must leave every operator's result bit-identical."""
+    from oceanbase_tpu.vector import to_numpy as _to_np
+
+    padded = _sample_rel().pad_to(64)
+    clean = _to_np(fn(padded))
+    poisoned = _to_np(fn(poison.poison_pad_lanes(padded)))
+    ok, why = poison.results_identical(clean, poisoned)
+    assert ok, f"{name}: {why}"
+
+
+def test_poisoned_join_matches_clean(poison):
+    left = _sample_rel().pad_to(64)
+    right = from_numpy({
+        "k2": np.array([1, 2, 5], dtype=np.int64),
+        "w": np.array([100, 200, 500], dtype=np.int64),
+    }).pad_to(64)
+    for how in ("inner", "left", "semi", "anti"):
+        clean = ops.join(left, right, [ir.col("k")], [ir.col("k2")],
+                         how=how, out_capacity=64)
+        pois = ops.join(poison.poison_pad_lanes(left),
+                        poison.poison_pad_lanes(right),
+                        [ir.col("k")], [ir.col("k2")],
+                        how=how, out_capacity=64)
+        assert sorted(_rows(clean), key=repr) == \
+            sorted(_rows(pois), key=repr), how
+
+
 def test_padded_join_matches_exact():
     left = _sample_rel()
     right = from_numpy({

@@ -88,3 +88,31 @@ def test_q14(db):
     out = execute_plan(q14_plan(len(li["l_orderkey"])), _table_data(cat))
     res = to_numpy(out)
     np.testing.assert_allclose(res["promo_revenue"][0], oracle, rtol=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# poison-lane verifier (obcheck dynamic half): pad every input to the
+# next bucket, fill the dead lanes with NaN/sentinel garbage, and demand
+# bit-identical results — the Static-shape policy as an executable check
+# ---------------------------------------------------------------------------
+
+
+def _padded_tables(cat):
+    from oceanbase_tpu.vector import bucket_capacity
+
+    out = {}
+    for t in cat.tables():
+        rel = cat.table_data(t)
+        # +1 guarantees at least one masked-dead pad lane per table
+        out[t] = rel.pad_to(bucket_capacity(rel.capacity + 1))
+    return out
+
+
+@pytest.mark.parametrize("qname", ["q6", "q1", "q14"])
+def test_poison_lanes_tpch(db, poison, qname):
+    cat, tables = db
+    n = len(tables["lineitem"]["l_orderkey"])
+    plan = {"q6": q6_plan, "q1": q1_plan,
+            "q14": lambda: q14_plan(n)}[qname]()
+    poison.assert_poison_invariant(
+        lambda tabs: execute_plan(plan, tabs), _padded_tables(cat))
