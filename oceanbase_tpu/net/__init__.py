@@ -13,7 +13,19 @@ snapshot scans, and SQL routing between nodes.
 """
 
 from oceanbase_tpu.net.codec import decode_msg, encode_msg
-from oceanbase_tpu.net.rpc import RpcClient, RpcError, RpcServer
+from oceanbase_tpu.net.faults import FaultDrop, FaultPlane, FaultReset
+from oceanbase_tpu.net.health import HealthMonitor
+from oceanbase_tpu.net.rpc import (
+    DeadlineExceeded,
+    ProtocolError,
+    RpcClient,
+    RpcError,
+    RpcServer,
+    VerbPolicy,
+    verb_policy,
+)
 
 __all__ = ["encode_msg", "decode_msg", "RpcServer", "RpcClient",
-           "RpcError"]
+           "RpcError", "ProtocolError", "DeadlineExceeded",
+           "VerbPolicy", "verb_policy", "FaultPlane", "FaultDrop",
+           "FaultReset", "HealthMonitor"]

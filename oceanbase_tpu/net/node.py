@@ -26,6 +26,8 @@ import time
 
 import numpy as np
 
+from oceanbase_tpu.net.faults import FaultPlane
+from oceanbase_tpu.net.health import HealthMonitor
 from oceanbase_tpu.net.rpc import RpcClient, RpcError, RpcServer
 from oceanbase_tpu.palf.cluster import NoQuorum, NotLeader
 from oceanbase_tpu.palf.netcluster import NetPalf
@@ -68,6 +70,7 @@ class NodeDatabase:
         self.ash = None
         self.dtl_metrics = DtlMetrics()
         self.dtl = None  # DtlExchange, installed by NodeServer
+        self.health = None  # HealthMonitor, installed by NodeServer
         self.virtual_tables = VirtualTables(self)
         self._session_ids = itertools.count(1)
 
@@ -103,7 +106,15 @@ class NodeServer:
 
         self.node_id = node_id
         self.peer_addrs = dict(peers)
-        self.peers = {pid: RpcClient(h, p)
+        self.config = Config(persist_path=(
+            os.path.join(root, "config.json") if root else None))
+        # per-process fault plane: every frame this node sends or
+        # receives consults it (seeded — nemesis schedules replay)
+        self.faults = FaultPlane(seed=int(self.config["fault_seed"]))
+        pool = int(self.config["rpc_conn_pool_size"])
+        self.peers = {pid: RpcClient(h, p, peer_id=pid,
+                                     local_id=node_id,
+                                     faults=self.faults, pool_size=pool)
                       for pid, (h, p) in peers.items()}
         self._apply_lock = threading.RLock()
         self._replay_pending: dict = {}
@@ -112,8 +123,6 @@ class NodeServer:
         self.palf = NetPalf(node_id, self.peers, log_dir=wal_dir,
                             apply_cb=self._apply_entry,
                             lease_ms=lease_ms)
-        self.config = Config(persist_path=(
-            os.path.join(root, "config.json") if root else None))
         self.tenant = Tenant("sys", root, self.config, wal=self.palf)
         self.engine = self.tenant.engine
         self.tx = self.tenant.tx
@@ -127,6 +136,17 @@ class NodeServer:
         self.db.dtl = DtlExchange(self, self.db.dtl_metrics)
         self.location = LocationCache(node_id, self.peers,
                                       self.palf._on_state)
+        # failure detector: heartbeats + per-call outcomes feed the
+        # three-state breaker; a dead leader triggers re-election
+        self.health = HealthMonitor(
+            node_id, self.peers,
+            interval_s=float(self.config["health_ping_interval_s"]),
+            suspect_after=int(self.config["health_suspect_threshold"]),
+            down_after=int(self.config["health_down_threshold"]),
+            on_down=self._on_peer_down)
+        for pid, cli in self.peers.items():
+            cli.observer = self.health.observer(pid)
+        self.db.health = self.health
 
         handlers = {
             "ping": lambda: "pong",
@@ -135,9 +155,13 @@ class NodeServer:
             "dtl.execute": self._h_dtl_execute,
             "sql.execute": self._h_execute,
             "node.state": self._h_state,
+            "cluster.health": self._h_health,
+            "fault.inject": self._h_fault_inject,
+            "fault.clear": self._h_fault_clear,
             **self.palf.handlers(),
         }
-        self.server = RpcServer(host, port, handlers)
+        self.server = RpcServer(host, port, handlers,
+                                faults=self.faults)
         self._sessions: dict = {}
         self._stop = threading.Event()
         self._hb: threading.Thread | None = None
@@ -183,6 +207,41 @@ class NodeServer:
                                  if not t.startswith("__idx__")),
                 "gts": self.tx.gts.current(),
                 **self.palf._on_state()}
+
+    def _h_health(self):
+        """Failure-detector snapshot (the wire face of
+        gv$cluster_health)."""
+        return {"node_id": self.node_id,
+                "peers": self.health.snapshot()}
+
+    def _h_fault_inject(self, where: str, action: str, verb=None,
+                        peer=None, prob: float = 1.0, nth=None,
+                        count: int = -1, delay_ms: float = 0.0,
+                        seed=None):
+        """Admin verb arming one FaultPlane rule on THIS node (≙ ALTER
+        SYSTEM SET ... errsim tracepoints; gated by config so a stray
+        client cannot chaos a production cluster)."""
+        if not bool(self.config["enable_fault_injection"]):
+            raise PermissionError(
+                "fault injection disabled: alter system set "
+                "enable_fault_injection = true first")
+        rid = self.faults.inject(where, action, verb=verb, peer=peer,
+                                 prob=prob, nth=nth, count=count,
+                                 delay_ms=delay_ms, seed=seed)
+        return {"rule_id": rid, "node_id": self.node_id}
+
+    def _h_fault_clear(self, rule_id=None):
+        return {"removed": self.faults.clear(rule_id),
+                "node_id": self.node_id}
+
+    def _on_peer_down(self, pid: int):
+        """Failure-detector down transition: stop routing at the dead
+        peer, and if it was the leader, campaign NOW instead of letting
+        writes ride out the remaining lease (≙ election priority takeover
+        on server blacklist events)."""
+        self.location.invalidate()
+        if not self._stop.is_set():
+            self.palf.on_peer_down(pid)
 
     def _h_scan(self, table: str, snapshot: int | None = None,
                 offset: int = 0, limit: int = SCAN_CHUNK_ROWS):
@@ -406,6 +465,7 @@ class NodeServer:
         self.server.start()
         self._hb = threading.Thread(target=self._heartbeat, daemon=True)
         self._hb.start()
+        self.health.start()
         if self._bootstrap:
             threading.Thread(target=self._bootstrap_elect,
                              daemon=True).start()
@@ -433,6 +493,7 @@ class NodeServer:
 
     def stop(self):
         self._stop.set()
+        self.health.stop()
         self.server.stop()
         self.palf.close()
 

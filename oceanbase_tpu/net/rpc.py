@@ -1,23 +1,45 @@
 """Length-prefixed TCP RPC: threaded server + pooled client.
 
 Reference analog: the rpc frame (deps/oblib/src/rpc/frame,
-ObReqTransport + macro-generated ObRpcProxy stubs).  Here: one TCP
-connection per client, u32-framed codec messages, a method-name
+ObReqTransport + macro-generated ObRpcProxy stubs).  Here: a small
+per-client connection pool, u32-framed codec messages, a method-name
 dispatch table on the server, synchronous request/response.
 
-Request body:  {"method": str, "params": {...}, "rid": int}
+Request body:  {"method": str, "params": {...}, "rid": int, "src": int?}
 Response body: {"rid": int, "ok": bool, "result": ... | "error": str}
+
+Robustness plane (≙ ObRpcProxy timeout/retry discipline + the
+ObReqTransport error path):
+
+- every verb carries a **policy** (`POLICIES`): a deadline, an
+  idempotence bit, and a retry budget.  Idempotent verbs (reads, state
+  probes, the prev-lsn/term-checked PALF protocol) get jittered
+  exponential backoff inside the deadline; non-idempotent verbs are
+  NEVER resent once the request hit the wire — they fail fast at the
+  deadline instead of riding a socket timeout.
+- calls check out a pooled connection for the round-trip, so a slow bulk
+  transfer cannot queue control-plane pings behind it.
+- any mid-frame failure (including oversized/garbled frames) closes the
+  connection instead of leaving unread bytes to desynchronize the next
+  call.
+- a `FaultPlane` (net/faults.py), when installed, is consulted on every
+  frame in and out — the deterministic chaos hook.
 """
 
 from __future__ import annotations
 
 import itertools
+import random
+import select
 import socket
 import socketserver
 import struct
 import threading
+import time
+from dataclasses import dataclass
 
 from oceanbase_tpu.net.codec import decode_msg, encode_msg
+from oceanbase_tpu.net.faults import FaultDrop, FaultReset
 
 _U32 = struct.Struct("<I")
 MAX_MSG = 1 << 30
@@ -31,9 +53,84 @@ class RpcError(RuntimeError):
         self.kind = kind
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+class ProtocolError(RpcError):
+    """Frame-level corruption (oversized header, undecodable body).
+    The connection is desynchronized and must be closed."""
+
+    def __init__(self, msg: str):
+        super().__init__("Protocol", msg)
+
+
+class DeadlineExceeded(TimeoutError):
+    """The verb's deadline elapsed before a reply arrived.  Subclasses
+    TimeoutError (hence OSError) so every existing ``except OSError``
+    failure path treats it as the network fault it is."""
+
+
+# ---------------------------------------------------------------------------
+# per-verb deadline / retry policy table (≙ the proxy stubs' timeout +
+# OB_RPC_NEED_RETRY discipline, declared per verb instead of per call site)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class VerbPolicy:
+    deadline_s: float          # end-to-end budget for the call
+    idempotent: bool           # may the request be RESENT after it was sent?
+    max_retries: int = 0       # resend budget (idempotent only)
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 1.0
+
+
+#: Verbs absent from this table get DEFAULT_POLICY: non-idempotent,
+#: never resent, 10 s deadline.  Idempotence notes:
+#: - reads / state probes are trivially idempotent;
+#: - palf.vote: the acceptor grants at most one vote per term and
+#:   re-answers the same candidate identically — re-ask is safe;
+#: - palf.accept/commit: prev-lsn/term-checked appends and commit-point
+#:   advances are idempotent (re-applying is a no-op), the Raft property;
+#: - sql.execute carries DML — never resent, the session retries at the
+#:   statement layer where NotLeader routing decides.
+POLICIES: dict[str, VerbPolicy] = {
+    "ping":         VerbPolicy(1.0, True, 2, 0.02, 0.10),
+    "node.state":   VerbPolicy(2.0, True, 2, 0.02, 0.20),
+    "palf.state":   VerbPolicy(2.0, True, 2, 0.02, 0.20),
+    "palf.vote":    VerbPolicy(2.0, True, 1, 0.02, 0.20),
+    "palf.accept":  VerbPolicy(10.0, True, 1, 0.05, 0.50),
+    "palf.commit":  VerbPolicy(5.0, True, 1, 0.02, 0.20),
+    "das.scan":     VerbPolicy(30.0, True, 3, 0.05, 1.00),
+    "das.pull":     VerbPolicy(120.0, True, 2, 0.05, 1.00),
+    "dtl.execute":  VerbPolicy(120.0, True, 2, 0.10, 2.00),
+    # fault.inject MUTATES plane state and mints a fresh rule id per
+    # call — a lost-reply resend would double-arm the rule, so it is
+    # non-idempotent; clear (remove by id / remove all) re-applies
+    # harmlessly
+    "fault.inject": VerbPolicy(5.0, False),
+    "fault.clear":  VerbPolicy(5.0, True, 2, 0.02, 0.20),
+    "cluster.health": VerbPolicy(2.0, True, 2, 0.02, 0.20),
+    "sql.execute":  VerbPolicy(600.0, False),
+}
+
+DEFAULT_POLICY = VerbPolicy(10.0, False)
+
+
+def verb_policy(method: str) -> VerbPolicy:
+    return POLICIES.get(method, DEFAULT_POLICY)
+
+
+def _recv_exact(sock: socket.socket, n: int,
+                deadline: float | None = None) -> bytes | None:
+    """``deadline`` (monotonic) makes the read END-TO-END bounded: the
+    socket timeout is re-armed with the REMAINING budget before every
+    chunk, so a peer trickling bytes cannot keep the call alive by
+    resetting a fixed per-recv window each burst."""
     chunks = []
     while n > 0:
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout("deadline exceeded mid-frame")
+            sock.settimeout(remaining)
         b = sock.recv(min(n, 1 << 20))
         if not b:
             return None
@@ -46,14 +143,18 @@ def _send_frame(sock: socket.socket, payload: bytes):
     sock.sendall(_U32.pack(len(payload)) + payload)
 
 
-def _recv_frame(sock: socket.socket) -> bytes | None:
-    hdr = _recv_exact(sock, 4)
+def _recv_frame(sock: socket.socket,
+                deadline: float | None = None) -> bytes | None:
+    hdr = _recv_exact(sock, 4, deadline)
     if hdr is None:
         return None
     (n,) = _U32.unpack(hdr)
     if n > MAX_MSG:
-        raise RpcError("Protocol", f"frame too large: {n}")
-    return _recv_exact(sock, n)
+        # unread bytes follow a bogus header — the stream is
+        # desynchronized; both consult sites close the connection on
+        # ProtocolError so the next call starts on a clean socket
+        raise ProtocolError(f"frame too large: {n}")
+    return _recv_exact(sock, n, deadline)
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -62,17 +163,32 @@ class _Handler(socketserver.BaseRequestHandler):
         while True:
             try:
                 frame = _recv_frame(self.request)
+            except ProtocolError:
+                return  # desynchronized stream: drop the connection
             except (ConnectionError, OSError):
                 return
             if frame is None:
                 return
-            msg = decode_msg(frame)
+            try:
+                msg = decode_msg(frame)
+            except Exception:  # noqa: BLE001 — any codec failure
+                return  # garbled frame: close, the client reconnects
             rid = msg.get("rid", 0)
-            fn = self.server.handlers.get(msg.get("method"))
+            verb = msg.get("method")
+            src = msg.get("src")
+            faults = self.server.faults
+            if faults is not None:
+                try:
+                    faults.act("recv", verb, src)
+                except FaultDrop:
+                    continue  # request lost in the network: no reply
+                except FaultReset:
+                    return
+            fn = self.server.handlers.get(verb)
             if fn is None:
                 resp = {"rid": rid, "ok": False,
                         "error_kind": "NoSuchMethod",
-                        "error": str(msg.get("method"))}
+                        "error": str(verb)}
             else:
                 try:
                     result = fn(**(msg.get("params") or {}))
@@ -81,8 +197,18 @@ class _Handler(socketserver.BaseRequestHandler):
                     resp = {"rid": rid, "ok": False,
                             "error_kind": type(e).__name__,
                             "error": str(e)}
+            payload = encode_msg(resp)
+            if faults is not None:
+                # the handler RAN by now — a reply fault is the
+                # lost-response case non-idempotent verbs must surface
+                try:
+                    payload = faults.act("reply", verb, src, payload)
+                except FaultDrop:
+                    continue
+                except FaultReset:
+                    return
             try:
-                _send_frame(self.request, encode_msg(resp))
+                _send_frame(self.request, payload)
             except (ConnectionError, OSError):
                 return
 
@@ -91,9 +217,11 @@ class RpcServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, host: str, port: int, handlers: dict):
+    def __init__(self, host: str, port: int, handlers: dict,
+                 faults=None):
         super().__init__((host, port), _Handler)
         self.handlers = dict(handlers)
+        self.faults = faults
         self._thread: threading.Thread | None = None
 
     def register(self, name: str, fn):
@@ -114,71 +242,185 @@ class RpcServer(socketserver.ThreadingTCPServer):
 
 
 class RpcClient:
-    """One connection, lazily (re)established; thread-safe via a lock
-    (requests serialize per connection — fine for the host control
-    plane; PX data stays on ICI collectives)."""
+    """Pooled connections to one peer, checkout/checkin per call.
 
-    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+    Each call owns a connection for exactly its round-trip, so a slow or
+    hung bulk transfer (``dtl.execute`` on a cold jit cache) cannot queue
+    control-plane pings or PALF heartbeats behind it.  Failed
+    connections are closed, never returned to the pool.
+
+    ``observer`` (optional) receives per-call outcomes — the failure
+    detector's signal source: record_success(rtt_s) / record_failure() /
+    record_retry() / record_deadline().
+    """
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0,
+                 peer_id: int | None = None, local_id: int | None = None,
+                 faults=None, observer=None, pool_size: int = 4):
         self.addr = (host, port)
-        self.timeout_s = timeout_s
-        self._sock: socket.socket | None = None
+        self.timeout_s = timeout_s  # connect timeout + policy fallback
+        self.peer_id = peer_id
+        self.local_id = local_id
+        self.faults = faults
+        self.observer = observer
+        self._pool: list[socket.socket] = []
+        self._pool_size = pool_size
         self._rid = itertools.count(1)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards the pool list only
 
-    def _connect(self):
-        s = socket.create_connection(self.addr, timeout=self.timeout_s)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock = s
-
-    def call(self, method: str, **params):
-        return self.call_with_size(method, **params)[0]
-
-    def call_with_size(self, method: str, **params):
-        """Like call(), but also returns the wire cost:
-        -> (result, sent_bytes, recv_bytes)."""
-        with self._lock:
-            req = encode_msg({"method": method, "params": params,
-                              "rid": next(self._rid)})
-            for attempt in (0, 1):
-                if self._sock is None:
-                    self._connect()
-                try:
-                    _send_frame(self._sock, req)
-                except (ConnectionError, OSError):
-                    # send failed -> the handler cannot have run; a stale
-                    # pooled connection is the common cause, reconnect once
-                    self.close()
-                    if attempt:
-                        raise
-                    continue
-                try:
-                    frame = _recv_frame(self._sock)
-                except (ConnectionError, OSError):
-                    # the request MAY have executed remotely — never
-                    # resend non-idempotent work; surface the failure
-                    self.close()
-                    raise
+    # -- pool ----------------------------------------------------------
+    def _checkout(self, timeout: float) -> socket.socket:
+        while True:
+            with self._lock:
+                s = self._pool.pop() if self._pool else None
+            if s is None:
+                s = socket.create_connection(
+                    self.addr, timeout=min(timeout, self.timeout_s))
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 break
-            if frame is None:
-                self.close()
-                raise ConnectionError(f"peer {self.addr} closed")
-            sent = len(req) + 4
-            recv = len(frame) + 4
-            resp = decode_msg(frame)
-            if not resp.get("ok"):
-                raise RpcError(resp.get("error_kind", "Remote"),
-                               resp.get("error", ""))
-            return resp.get("result"), sent, recv
+            # an idle request/response socket should never be readable;
+            # readable means the peer closed it (or sent garbage) while
+            # pooled — discard instead of letting a doomed send turn
+            # into a spurious "may have executed" on non-idempotent work
+            r, _, _ = select.select([s], [], [], 0)
+            if not r:
+                break
+            s.close()
+        s.settimeout(timeout)
+        return s
 
-    def ping(self) -> bool:
+    def _checkin(self, s: socket.socket):
+        with self._lock:
+            if len(self._pool) < self._pool_size:
+                self._pool.append(s)
+                return
+        s.close()
+
+    # -- calls ---------------------------------------------------------
+    def call(self, method: str, _deadline_s: float | None = None,
+             **params):
+        return self.call_with_size(method, _deadline_s=_deadline_s,
+                                   **params)[0]
+
+    def call_with_size(self, method: str,
+                       _deadline_s: float | None = None, **params):
+        """Like call(), but also returns the wire cost:
+        -> (result, sent_bytes, recv_bytes).
+
+        ``_deadline_s`` overrides the verb policy's deadline (the
+        heartbeat loop probes with a budget tied to its own period)."""
+        pol = verb_policy(method)
+        deadline_s = pol.deadline_s if _deadline_s is None \
+            else float(_deadline_s)
+        t0 = time.monotonic()
+        deadline = t0 + deadline_s
+        body = {"method": method, "params": params,
+                "rid": next(self._rid)}
+        if self.local_id is not None:
+            body["src"] = self.local_id
+        req = encode_msg(body)
+        obs = self.observer
+        attempt = 0
+        while True:
+            sent_ok = False
+            conn: socket.socket | None = None
+            a0 = time.monotonic()  # per-ATTEMPT rtt (a success after
+            #                        retries must not fold the failed
+            #                        attempts' backoff into the ewma)
+            try:
+                payload = req
+                if self.faults is not None:
+                    # consult BEFORE computing the remaining budget: an
+                    # injected delay must burn the deadline like real
+                    # network latency would
+                    payload = self.faults.act(
+                        "send", method, self.peer_id, payload) or req
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise DeadlineExceeded(
+                        f"{method} to {self.addr}: deadline "
+                        f"{deadline_s:.3f}s exceeded")
+                conn = self._checkout(remaining)
+                _send_frame(conn, payload)
+                sent_ok = True
+                frame = _recv_frame(conn, deadline)
+                if frame is None:
+                    raise ConnectionError(f"peer {self.addr} closed")
+                try:
+                    resp = decode_msg(frame)
+                except Exception as e:  # noqa: BLE001 — codec failure
+                    raise ProtocolError(f"undecodable reply: {e}") from e
+                self._checkin(conn)
+                conn = None
+                if obs is not None:
+                    obs.record_success(time.monotonic() - a0)
+                sent = len(req) + 4
+                recv = len(frame) + 4
+                if not resp.get("ok"):
+                    # the handler ran and raised — a remote APPLICATION
+                    # error, deterministic on resend: never retried here
+                    raise RpcError(resp.get("error_kind", "Remote"),
+                                   resp.get("error", ""))
+                return resp.get("result"), sent, recv
+            except (ConnectionError, OSError, ProtocolError) as e:
+                # any mid-frame failure leaves the stream unusable:
+                # close it (never back to the pool) so the next attempt
+                # reconnects cleanly
+                if conn is not None:
+                    conn.close()
+                now = time.monotonic()
+                timed_out = isinstance(e, (socket.timeout,
+                                           DeadlineExceeded)) \
+                    or now >= deadline
+                if obs is not None:
+                    obs.record_failure()
+                    if timed_out:
+                        obs.record_deadline()
+                # a request that never hit the wire is always safe to
+                # retry; once SENT, only policy-declared idempotent
+                # verbs may be resent (the reply may be the lost frame)
+                may_retry = (not sent_ok) or pol.idempotent
+                if not may_retry or attempt >= max(pol.max_retries, 1):
+                    raise self._at_deadline(e, method, now, deadline,
+                                            deadline_s)
+                backoff = min(pol.backoff_base_s * (2 ** attempt),
+                              pol.backoff_cap_s)
+                backoff *= 0.5 + random.random()  # full jitter
+                if now + backoff >= deadline:
+                    raise self._at_deadline(e, method, now, deadline,
+                                            deadline_s)
+                time.sleep(backoff)
+                attempt += 1
+                if obs is not None:
+                    obs.record_retry()
+
+    def _at_deadline(self, e: Exception, method: str, now: float,
+                     deadline: float, deadline_s: float) -> Exception:
+        """Normalize a terminal failure: past the deadline every error
+        becomes DeadlineExceeded (fail fast, one kind to handle)."""
+        if isinstance(e, DeadlineExceeded):
+            return e
+        if now >= deadline or isinstance(e, socket.timeout):
+            exc = DeadlineExceeded(
+                f"{method} to {self.addr}: deadline "
+                f"{deadline_s:.3f}s exceeded ({e})")
+            exc.__cause__ = e
+            return exc
+        return e
+
+    def ping(self, _deadline_s: float | None = None) -> bool:
         try:
-            return self.call("ping") == "pong"
+            return self.call("ping", _deadline_s=_deadline_s) == "pong"
         except (OSError, RpcError):
             return False
 
     def close(self):
-        if self._sock is not None:
+        """Drop every pooled connection (the client stays usable — the
+        next call dials fresh, matching the old reconnect semantics)."""
+        with self._lock:
+            pool, self._pool = self._pool, []
+        for s in pool:
             try:
-                self._sock.close()
-            finally:
-                self._sock = None
+                s.close()
+            except OSError:
+                pass

@@ -23,6 +23,7 @@ RPC endpoints this class registers on its node's server:
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Callable, Optional
@@ -92,20 +93,26 @@ class NetPalf:
         r = self.replica
         try:
             st = cli.call("palf.state")
+            if int(st.get("term", 0)) > r.current_term:
+                # the cluster moved on to a newer term: we are a stale
+                # leader — stop shipping (our lease lapses, we step down)
+                return False
             prev = min(r.last_lsn(), int(st["last_lsn"]))
             while prev > 0:
                 ok = cli.call(
                     "palf.accept", prev_lsn=prev,
                     prev_term=r.term_at(prev),
                     entries=_encode_entries(r.entries[prev:]),
-                    leader_id=self.node_id, commit=commit)
+                    leader_id=self.node_id, commit=commit,
+                    term=r.current_term)
                 if ok:
                     return True
                 prev -= 1
             return bool(cli.call(
                 "palf.accept", prev_lsn=0, prev_term=0,
                 entries=_encode_entries(r.entries),
-                leader_id=self.node_id, commit=commit))
+                leader_id=self.node_id, commit=commit,
+                term=r.current_term))
         except OSError:
             return False
 
@@ -128,14 +135,24 @@ class NetPalf:
                 "voter": rep.voter}
 
     def _on_accept(self, prev_lsn, prev_term, entries, leader_id,
-                   commit):
+                   commit, term=None):
         with self._lock:
             r = self.replica
             es = _decode_entries(entries)
-            # a valid append refreshes follower state: the sender holds a
-            # majority-granted lease for its term
-            if es and es[-1].term >= r.current_term:
-                r.current_term = es[-1].term
+            # sender's leadership term; older wires omit it — fall back
+            # to the shipped entries' last term as before
+            sender_term = (int(term) if term is not None
+                           else (es[-1].term if es else None))
+            if sender_term is not None and sender_term < r.current_term:
+                # Raft safety: a DEPOSED leader's append must not
+                # truncate the new leader's entries (its conflicting
+                # suffix would overwrite possibly-committed log) — and
+                # must not count as an ack that refreshes its lease
+                return False
+            # a valid append refreshes follower state: the sender holds
+            # a majority-granted lease for its term
+            if sender_term is not None and sender_term >= r.current_term:
+                r.current_term = sender_term
                 if r.role == "leader" and leader_id != self.node_id:
                     r.role = "follower"
                 self.leader_hint = int(leader_id)
@@ -145,8 +162,10 @@ class NetPalf:
                 r.advance_commit(min(int(commit), r.last_lsn()))
             return ok
 
-    def _on_commit(self, commit_lsn, leader_id):
+    def _on_commit(self, commit_lsn, leader_id, term=None):
         with self._lock:
+            if term is not None and int(term) < self.replica.current_term:
+                return False  # stale leader's commit point: ignore
             self.leader_hint = int(leader_id)
             self.replica.advance_commit(
                 min(int(commit_lsn), self.replica.last_lsn()))
@@ -177,6 +196,38 @@ class NetPalf:
                 self._replicate([b'{"op": "noop"}'])
                 return self.node_id
             raise NoQuorum(f"node {self.node_id} lost the election")
+
+    def on_peer_down(self, peer_id: int, attempts: int = 8) -> bool:
+        """Failure-detector hook: the cluster health monitor declared
+        ``peer_id`` down.  If that peer is the replica we believe leads,
+        campaign IMMEDIATELY instead of waiting for the next write to
+        pay out the remaining lease (≙ takeover election on a dead
+        leader's lease, palf/election).  The survivors of a 3-node
+        cluster detect the death near-simultaneously and would split the
+        vote forever if symmetric, so campaigns are staggered by a
+        node-id offset plus randomized, growing backoff (≙ election
+        priority + randomized timeouts).  -> True if this node won."""
+        if self.replica.role == "leader":
+            return False
+        if self.leader_hint is not None and self.leader_hint != peer_id:
+            return False  # somebody else leads as far as we know
+        stagger = 0.12 * ((self.node_id * 7) % 5)
+        for attempt in range(max(attempts, 1)):
+            time.sleep(stagger
+                       + random.uniform(0.02, 0.15) * (attempt + 1))
+            if self.replica.role == "leader":
+                return True
+            hint = self.leader_hint
+            if hint is not None and hint not in (peer_id, self.node_id):
+                return False  # a rival already won; follow it
+            try:
+                self.elect()
+                return True
+            except NoQuorum:
+                continue
+            except OSError:
+                continue
+        return False
 
     def ensure_leader(self, campaign: bool = False):
         if self.is_leader:
@@ -213,7 +264,7 @@ class NetPalf:
         for pid, cli in self.peers.items():
             try:
                 cli.call("palf.commit", commit_lsn=r.committed_lsn,
-                         leader_id=self.node_id)
+                         leader_id=self.node_id, term=r.current_term)
             except OSError:
                 pass
         return r.committed_lsn

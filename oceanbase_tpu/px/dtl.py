@@ -495,7 +495,8 @@ class DtlRecord:
     pushdown_hit: bool
     bytes_shipped: int
     rows_shipped: int
-    fallback_parts: int = 0
+    fallback_parts: int = 0    # slices re-run locally AFTER a failure
+    avoided_parts: int = 0     # slices routed locally PRE-EMPTIVELY
     elapsed_s: float = 0.0
 
 
@@ -554,7 +555,17 @@ class DtlExchange:
             cli = self._chan.get(pid)
             if cli is None:
                 h, p = self.node.peer_addrs[pid]
-                cli = RpcClient(h, p, timeout_s=60.0)
+                # share the node's fault plane and failure detector:
+                # injected dtl.execute faults hit the data channels too,
+                # and their outcomes feed the breaker like control
+                # traffic does
+                health = getattr(self.node, "health", None)
+                cli = RpcClient(
+                    h, p, timeout_s=60.0, peer_id=pid,
+                    local_id=self.node.node_id,
+                    faults=getattr(self.node, "faults", None),
+                    observer=(health.observer(pid)
+                              if health is not None else None))
                 self._chan[pid] = cli
             return cli
 
@@ -587,6 +598,20 @@ class DtlExchange:
         nparts = 1 + len(peers)
         if nparts < 2:
             return None
+        # failure detector (net/health.py): slices owned by suspect /
+        # down peers run locally FROM THE START — pre-emptive avoidance
+        # instead of paying the rpc deadline and then falling back (≙
+        # the PX scheduler consulting the server blacklist when it
+        # places SQCs).  The hash slicing is node-independent, so WHO
+        # executes a part never changes the result.
+        health = getattr(node, "health", None)
+        remote: list = []        # (part index, client) worth shipping
+        avoided_parts: list = [0]  # part 0 is always the coordinator's
+        for i, (pid, cli) in enumerate(peers):
+            if health is not None and health.state(pid) != "up":
+                avoided_parts.append(i + 1)
+            else:
+                remote.append((i + 1, cli))
         snap = node.tx.gts.current()
         lsn = node.palf.replica.applied_lsn
         t0 = time.time()
@@ -605,15 +630,17 @@ class DtlExchange:
             except Exception as e:  # noqa: BLE001 — triaged below
                 errors[i] = e
 
-        threads = [threading.Thread(target=run_peer, args=(i + 1, cli),
+        threads = [threading.Thread(target=run_peer, args=(i, cli),
                                     daemon=True)
-                   for i, (_pid, cli) in enumerate(peers)]
+                   for i, cli in remote]
         for t in threads:
             t.start()
-        # the coordinator's own slice runs locally while peers work
-        results[0] = node._h_dtl_execute(
-            plan=push.encoded, table=push.table, snapshot=snap,
-            part=0, nparts=nparts)
+        # the coordinator's own slice — and every slice routed away
+        # from an unhealthy peer — runs locally while peers work
+        for i in avoided_parts:
+            results[i] = node._h_dtl_execute(
+                plan=push.encoded, table=push.table, snapshot=snap,
+                part=i, nparts=nparts)
         for t in threads:
             t.join()
         fallbacks = 0
@@ -652,10 +679,12 @@ class DtlExchange:
             ts=t0, table=push.table, mode="pushdown", parts=nparts,
             pushdown_hit=True, bytes_shipped=sum(ship_bytes),
             rows_shipped=rows_shipped, fallback_parts=fallbacks,
+            avoided_parts=len(avoided_parts) - 1,
             elapsed_s=time.time() - t0)
         self.metrics.record(rec)
         if monitor is not None:
             monitor.append((
                 f"DtlExchange(parts={nparts},fallback={fallbacks},"
+                f"avoided={rec.avoided_parts},"
                 f"bytes={rec.bytes_shipped})", rows_shipped))
         return out

@@ -111,6 +111,29 @@ DEF("dtl_min_rows", 4096, "int",
     "minimum estimated base-table rows before a plan is considered for "
     "DTL pushdown (below it, per-node RPC overhead dominates)", _nonneg)
 
+# robustness: fault injection + failure detection (net/faults.py,
+# net/health.py)
+DEF("enable_fault_injection", False, "bool",
+    "allow the fault.inject/fault.clear admin RPC verbs to arm rules on "
+    "this node's FaultPlane (≙ errsim tracepoints scoped to the rpc "
+    "frame; scripts/chaos_bench.py nemesis schedules)")
+DEF("fault_seed", 0, "int",
+    "seed of the per-node FaultPlane rng — a failing nemesis schedule "
+    "replays frame-for-frame", _nonneg)
+DEF("health_ping_interval_s", 0.5, "float",
+    "failure-detector heartbeat period per peer; detection latency is "
+    "O(interval * health_down_threshold)", _pos)
+DEF("health_suspect_threshold", 2, "int",
+    "consecutive failures before a peer turns 'suspect' (PX slices "
+    "pre-emptively route away from it)", _pos)
+DEF("health_down_threshold", 4, "int",
+    "consecutive failures before a peer turns 'down' (a dead leader "
+    "triggers immediate re-election instead of lease expiry)", _pos)
+DEF("rpc_conn_pool_size", 4, "int",
+    "idle connections kept per RpcClient; calls beyond it dial extra "
+    "sockets so control-plane pings never queue behind bulk transfers",
+    _pos)
+
 # storage
 DEF("memstore_limit_rows", 1_000_000, "int",
     "freeze threshold per tablet (rows in active memtable)", _pos)

@@ -33,6 +33,7 @@ class VirtualTables:
             "gv$plan_monitor": self.plan_monitor,
             "gv$plan_cache": self.plan_cache,
             "gv$px_exchange": self.px_exchange,
+            "gv$cluster_health": self.cluster_health,
             "v$session_history": self.session_history,
             "v$parameters": self.parameters,
             "v$tenants": self.tenants,
@@ -125,8 +126,36 @@ class VirtualTables:
                                      np.int64),
             "fallback_parts": np.array([r.fallback_parts for r in recs],
                                        np.int64),
+            "avoided_parts": np.array(
+                [getattr(r, "avoided_parts", 0) for r in recs],
+                np.int64),
             "elapsed_s": np.array([r.elapsed_s for r in recs],
                                   np.float64),
+        }
+
+    def cluster_health(self):
+        """Failure-detector state per peer (net/health.py): the breaker
+        (up / suspect / down), RTT EWMA, and the retry/deadline counters
+        the per-verb rpc policy table accumulates (≙ the server
+        blacklist view, __all_virtual_server_blacklist_info)."""
+        h = getattr(self.db, "health", None)
+        rows = h.snapshot() if h is not None else []
+        return {
+            "peer": np.array([r["peer"] for r in rows], np.int64),
+            "state": _obj(r["state"] for r in rows),
+            "rtt_ewma_ms": np.array([r["rtt_ewma_ms"] for r in rows],
+                                    np.float64),
+            "consecutive_failures": np.array(
+                [r["consecutive_failures"] for r in rows], np.int64),
+            "breaker_opens": np.array([r["breaker_opens"] for r in rows],
+                                      np.int64),
+            "successes": np.array([r["successes"] for r in rows],
+                                  np.int64),
+            "failures": np.array([r["failures"] for r in rows],
+                                 np.int64),
+            "retries": np.array([r["retries"] for r in rows], np.int64),
+            "deadline_exceeded": np.array(
+                [r["deadline_exceeded"] for r in rows], np.int64),
         }
 
     def session_history(self):

@@ -124,7 +124,8 @@ class Session:
     def execute(self, sql: str, params: list | None = None) -> Result:
         """Parse + execute one statement, with request auditing and ASH
         state (≙ obmp_query process + sql_audit recording)."""
-        start = time.time()
+        start = time.time()        # wall ts for the audit record
+        t0 = time.monotonic()      # duration source (step-proof)
         err = ""
         out = None
         self._ash_state.update(active=True, sql=sql, state="executing")
@@ -145,7 +146,7 @@ class Session:
                 self.db.audit.record(AuditRecord(
                     sql=sql, session_id=self.session_id,
                     tenant=getattr(self.tenant, "name", ""),
-                    start_ts=start, elapsed_s=time.time() - start,
+                    start_ts=start, elapsed_s=time.monotonic() - t0,
                     rows=out.rowcount if out is not None else 0,
                     error=err,
                 ))
@@ -1587,12 +1588,15 @@ class Session:
             # inside it would deadlock; mirror MySQL's implicit-commit
             # by refusing instead of hanging
             live_before.discard(own_tx)
-            deadline = time.time() + timeout_s
+            # monotonic, not wall clock: an NTP step backwards would
+            # extend the online-DDL fence indefinitely, a step forward
+            # would expire it spuriously mid-drain
+            deadline = time.monotonic() + timeout_s
             while True:
                 with svc._lock:
                     if not (live_before & set(svc._live)):
                         return
-                if time.time() > deadline:
+                if time.monotonic() > deadline:
                     raise RuntimeError(
                         "CREATE INDEX timed out waiting for in-flight "
                         "transactions to finish")

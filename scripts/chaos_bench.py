@@ -1,0 +1,319 @@
+#!/usr/bin/env python
+"""Chaos bench: the TPC-H slice under a scripted nemesis schedule.
+
+Boots a real 3-node cluster (subprocess nodes, TCP rpc) with fault
+injection enabled, loads a lineitem slice, records the fault-free Q6 /
+Q1 answers, then replays the queries under three nemesis scenarios:
+
+  drop30      30% message loss on ``dtl.execute`` (client-side sends
+              from the coordinator) — the retry/backoff policy and
+              per-slice fallback must absorb it;
+  partition   the PALF leader partitioned from one follower (symmetric,
+              installed on both sides) — the failure detector routes
+              slices away and, if leadership moves, statement routing
+              follows it;
+  nodekill    SIGKILL a data node while a query is in flight — the
+              in-flight slice falls back to the coordinator's replica.
+
+Every query must return BIT-IDENTICAL rows to the fault-free baseline
+and finish inside the bench deadline (no query may ride a hung socket).
+Prints ONE dtl_bench-style JSON line: per-scenario parity, p99 latency,
+retry/breaker counters from gv$cluster_health.
+
+    python scripts/chaos_bench.py            # BENCH_ROWS=20000 default
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from oceanbase_tpu.net.rpc import RpcClient  # noqa: E402
+
+#: per-query wall bound: generous vs the dtl.execute deadline (120 s)
+#: but far below the 10 min sql.execute budget — a query that rides a
+#: hung socket instead of failing fast blows this
+QUERY_DEADLINE_S = 60.0
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def boot_cluster(root, n=3, seed=7):
+    ports = _free_ports(n)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = {}
+    for i in range(1, n + 1):
+        node_root = os.path.join(root, f"n{i}")
+        os.makedirs(node_root, exist_ok=True)
+        # arm the admin verb + pin the nemesis seed BEFORE boot (config
+        # is per-node; ALTER SYSTEM on a follower would route to the
+        # leader instead of the node under test)
+        with open(os.path.join(node_root, "config.json"), "w") as f:
+            json.dump({"enable_fault_injection": True,
+                       "fault_seed": seed}, f)
+        peers = ",".join(f"{j}=127.0.0.1:{ports[j - 1]}"
+                         for j in range(1, n + 1) if j != i)
+        cmd = [sys.executable, "-m", "oceanbase_tpu.net.node",
+               "--node-id", str(i), "--port", str(ports[i - 1]),
+               "--peers", peers, "--root", node_root]
+        if i == 1:
+            cmd.append("--bootstrap")
+        procs[i] = subprocess.Popen(cmd, env=env,
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=subprocess.DEVNULL)
+    clients = {i: RpcClient("127.0.0.1", ports[i - 1], timeout_s=60.0)
+               for i in range(1, n + 1)}
+    deadline = time.time() + 60
+    for i, cli in clients.items():
+        while time.time() < deadline:
+            if cli.ping():
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError(f"node {i} not ready")
+    return procs, clients
+
+
+def rows_of(res):
+    names = res["names"]
+    n = len(next(iter(res["arrays"].values()))) if names else 0
+    out = []
+    for r in range(n):
+        row = []
+        for nm in names:
+            v = res.get("valids", {}).get(nm)
+            if v is not None and not v[r]:
+                row.append(None)
+            else:
+                x = res["arrays"][nm][r]
+                row.append(x.item() if hasattr(x, "item") else x)
+        out.append(tuple(row))
+    return out
+
+
+def wait_converged(clients, table, n_rows, timeout=120):
+    deadline = time.time() + timeout
+    for i in (2, 3):
+        while time.time() < deadline:
+            try:
+                r = clients[i].call(
+                    "sql.execute",
+                    sql=f"select count(*) from {table}",
+                    consistency="weak")
+                if r["node"] == i and \
+                        int(r["arrays"][r["names"][0]][0]) == n_rows:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.3)
+        else:
+            raise TimeoutError(f"node {i} never converged")
+
+
+def wait_detector(cli, peer, states, timeout=20):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            h = cli.call("cluster.health")
+            st = {r["peer"]: r["state"] for r in h["peers"]}
+            if st.get(peer) in states:
+                return True
+        except Exception:
+            pass
+        time.sleep(0.2)
+    return False
+
+
+QUERIES = {
+    "q6": ("select sum(l_extendedprice * l_discount) from lineitem"
+           " where l_shipdate >= 8766 and l_shipdate < 9131"
+           " and l_discount >= 5 and l_discount <= 7"
+           " and l_quantity < 24"),
+    "q1": ("select l_returnflag, l_linestatus, sum(l_quantity),"
+           " sum(l_extendedprice), avg(l_discount), count(*)"
+           " from lineitem where l_shipdate <= 10000"
+           " group by l_returnflag, l_linestatus"
+           " order by l_returnflag, l_linestatus"),
+}
+
+
+def run_queries(exec_fn, baseline, repeats):
+    """-> (parity, latencies, hung) over ``repeats`` rounds of q6+q1.
+    A query is HUNG when it exceeds QUERY_DEADLINE_S (it must fail fast
+    or succeed inside its rpc deadlines, never sit on a dead socket)."""
+    lat, parity, hung = [], True, 0
+    for _ in range(repeats):
+        for name, sql in QUERIES.items():
+            t0 = time.monotonic()
+            got = rows_of(exec_fn(sql))
+            dt = time.monotonic() - t0
+            lat.append(dt)
+            if dt > QUERY_DEADLINE_S:
+                hung += 1
+            if got != baseline[name]:
+                parity = False
+    return parity, lat, hung
+
+
+def p99(lat):
+    return float(np.percentile(np.asarray(lat), 99)) if lat else 0.0
+
+
+def main():
+    n_rows = int(os.environ.get("BENCH_ROWS", "20000"))
+    seed = int(os.environ.get("BENCH_SEED", "7"))
+    root = tempfile.mkdtemp(prefix="chaosbench_")
+    procs = {}
+    out = {"metric": "chaos_bench", "rows": n_rows, "seed": seed,
+           "query_deadline_s": QUERY_DEADLINE_S, "scenarios": {}}
+    try:
+        procs, clients = boot_cluster(root, seed=seed)
+        c1 = clients[1]
+
+        def sql(text, node=1):
+            # statement-level retry over leadership churn: the rpc layer
+            # fails fast (NotLeader / deadline), the client re-routes —
+            # the degradation contract, not a hang
+            last = None
+            deadline = time.monotonic() + QUERY_DEADLINE_S
+            while time.monotonic() < deadline:
+                try:
+                    return clients[node].call("sql.execute", sql=text)
+                except Exception as e:  # noqa: BLE001 — retried
+                    last = e
+                    time.sleep(0.3)
+            raise TimeoutError(
+                f"query never succeeded inside {QUERY_DEADLINE_S}s: "
+                f"{last}")
+
+        sql("create table lineitem (l_id int primary key,"
+            " l_quantity int, l_extendedprice int, l_discount int,"
+            " l_shipdate int, l_returnflag int, l_linestatus int)")
+        rng = np.random.default_rng(1)
+        qty = rng.integers(1, 50, n_rows)
+        price = rng.integers(1000, 100000, n_rows)
+        disc = rng.integers(0, 10, n_rows)
+        ship = rng.integers(8766, 10227, n_rows)
+        rf = rng.integers(0, 3, n_rows)
+        ls = rng.integers(0, 2, n_rows)
+        t_load = time.time()
+        for s in range(0, n_rows, 1000):
+            e = min(s + 1000, n_rows)
+            vals = ", ".join(
+                f"({i}, {qty[i]}, {price[i]}, {disc[i]}, {ship[i]},"
+                f" {rf[i]}, {ls[i]})" for i in range(s, e))
+            sql(f"insert into lineitem values {vals}")
+        out["load_s"] = round(time.time() - t_load, 2)
+        wait_converged(clients, "lineitem", n_rows)
+        sql("alter system set dtl_min_rows = 1")
+
+        # ---- fault-free baseline -----------------------------------
+        baseline = {}
+        for name, q in QUERIES.items():
+            baseline[name] = rows_of(sql(q))
+        parity, lat, hung = run_queries(sql, baseline, repeats=3)
+        assert parity and hung == 0
+        out["scenarios"]["baseline"] = {
+            "parity": parity, "p99_s": round(p99(lat), 3),
+            "queries": len(lat), "hung": hung}
+
+        # ---- scenario 1: 30% drop on dtl.execute -------------------
+        c1.call("fault.inject", where="send", action="drop",
+                verb="dtl.execute", prob=0.30)
+        parity, lat, hung = run_queries(sql, baseline, repeats=6)
+        c1.call("fault.clear")
+        h = c1.call("cluster.health")
+        out["scenarios"]["drop30"] = {
+            "parity": parity, "p99_s": round(p99(lat), 3),
+            "queries": len(lat), "hung": hung,
+            "retries": sum(r["retries"] for r in h["peers"])}
+
+        # ---- scenario 2: partition the leader from node 2 ----------
+        for a, b in ((1, 2), (2, 1)):
+            for where in ("send", "recv"):
+                clients[a].call("fault.inject", where=where,
+                                action="drop", peer=b)
+        wait_detector(c1, 2, ("suspect", "down"))
+        # query through node 3 — it sees both sides of the partition
+        parity, lat, hung = run_queries(
+            lambda q: sql(q, node=3), baseline, repeats=3)
+        hp = c1.call("cluster.health")
+        for i in (1, 2):
+            clients[i].call("fault.clear")
+        wait_detector(c1, 2, ("up",))
+        out["scenarios"]["partition_leader"] = {
+            "parity": parity, "p99_s": round(p99(lat), 3),
+            "queries": len(lat), "hung": hung,
+            "leader_view": {r["peer"]: r["state"]
+                            for r in hp["peers"]}}
+
+        # ---- scenario 3: kill a data node mid-query ----------------
+        results = {}
+
+        def midq():
+            results["rows"] = rows_of(sql(QUERIES["q6"]))
+
+        th = threading.Thread(target=midq)
+        th.start()
+        time.sleep(0.05)  # the fan-out is (likely) in flight now
+        procs[3].send_signal(signal.SIGKILL)
+        procs[3].wait(timeout=10)
+        th.join(timeout=QUERY_DEADLINE_S)
+        assert not th.is_alive(), "mid-kill query hung"
+        mid_parity = results.get("rows") == baseline["q6"]
+        wait_detector(c1, 3, ("suspect", "down"))
+        parity, lat, hung = run_queries(sql, baseline, repeats=3)
+        h = c1.call("cluster.health")
+        st3 = {r["peer"]: r for r in h["peers"]}[3]
+        out["scenarios"]["nodekill_midquery"] = {
+            "parity": bool(mid_parity and parity),
+            "p99_s": round(p99(lat), 3), "queries": len(lat) + 1,
+            "hung": hung, "detector_state": st3["state"],
+            "breaker_opens": st3["breaker_opens"]}
+
+        # avoided slices show up in gv$px_exchange
+        ex = sql("select avoided_parts, fallback_parts from"
+                 " gv$px_exchange where mode = 'pushdown'"
+                 " order by ts desc limit 1")
+        av, fb = rows_of(ex)[0]
+        out["avoided_parts_last"] = int(av)
+        out["fallback_parts_last"] = int(fb)
+        out["parity_all"] = all(s["parity"]
+                                for s in out["scenarios"].values())
+        out["hung_total"] = sum(s["hung"]
+                                for s in out["scenarios"].values())
+        print(json.dumps(out))
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

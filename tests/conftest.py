@@ -32,6 +32,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy multi-process cluster scenarios excluded from the "
+        "tier-1 (-m 'not slow') gate")
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)

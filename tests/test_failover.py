@@ -1,6 +1,10 @@
 """Full-stack failure scenarios: WAL leader loss mid-workload, errsim
-fault storms (≙ mittest errsim failover suites, SURVEY §5.3).
+fault storms (≙ mittest errsim failover suites, SURVEY §5.3), and —
+over a real 3-process cluster — failure-detector-driven re-election and
+suspect-node slice avoidance (net/health.py + net/faults.py).
 """
+
+import time
 
 import pytest
 
@@ -61,3 +65,155 @@ def test_errsim_storm_keeps_consistency(tmp_path):
     ks = [row[0] for row in s.execute("select k from t").rows()]
     assert r == sum(ks)
     db.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster scenarios: failure detector + fault plane over real processes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_health_triggered_reelection_bounded(tmp_path):
+    """Kill the leader and issue NO statements: the failure detector on
+    the survivors must notice (heartbeat interval × down threshold) and
+    campaign AUTONOMOUSLY — the old code only re-elected when a write
+    arrived to pay the lease out."""
+    from test_multinode import Cluster
+
+    c = Cluster(tmp_path, n=3)
+    try:
+        c.execute(1, "create table t (k int primary key, v int)")
+        c.execute(1, "insert into t values (1, 1), (2, 2)")
+        t_kill = time.monotonic()
+        c.kill(1)
+        # detection ≈ health_ping_interval_s (0.5) × down threshold (4)
+        # with the ping policy's internal retries compressing rounds,
+        # plus one randomized-backoff election round — generously bound
+        bound_s = 15.0
+        new_leader = None
+        while time.monotonic() - t_kill < bound_s:
+            for i in (2, 3):
+                try:
+                    st = c.clients[i].call("palf.state",
+                                           _deadline_s=1.0)
+                    if st["role"] == "leader":
+                        new_leader = i
+                        break
+                except OSError:
+                    pass
+            if new_leader is not None:
+                break
+            time.sleep(0.2)
+        elapsed = time.monotonic() - t_kill
+        assert new_leader in (2, 3), \
+            f"no autonomous re-election within {bound_s}s"
+        # the cluster serves writes promptly — concurrent campaigns may
+        # still be settling, so retry the statement like any client
+        # (the documented NotLeader routing contract)
+        from oceanbase_tpu.net.rpc import RpcError
+
+        res = None
+        for _ in range(20):
+            try:
+                res = c.execute(new_leader,
+                                "insert into t values (3, 3)")
+                break
+            except (RpcError, OSError):
+                time.sleep(0.25)
+        assert res is not None, "write never succeeded after failover"
+        res = c.execute(5 - new_leader, "select count(*) from t")
+        assert c.rows(res)[0][0] == 3
+        # and the survivors' detectors agree the old leader is down
+        h = c.clients[new_leader].call("cluster.health")
+        st = {r["peer"]: r for r in h["peers"]}
+        assert st[1]["state"] == "down"
+        assert st[1]["consecutive_failures"] >= 1
+        assert elapsed < bound_s
+    finally:
+        c.close()
+
+
+@pytest.mark.slow
+def test_suspect_node_slice_avoidance_parity(tmp_path):
+    """One-way traffic loss leader→node3: the detector turns node 3
+    down, the DTL exchange routes its slice locally FROM THE START
+    (avoided_parts, not fallback_parts), and results stay bit-identical
+    with the serial path."""
+    import numpy as np
+
+    from test_multinode import Cluster
+
+    c = Cluster(tmp_path, n=3)
+    try:
+        c.execute(1, "create table q (k int primary key, v int, d int)")
+        rng = np.random.default_rng(3)
+        n = 1500
+        v = rng.integers(0, 100, n)
+        d = rng.integers(0, 1000, n)
+        for s in range(0, n, 500):
+            vals = ", ".join(f"({i}, {v[i]}, {d[i]})"
+                             for i in range(s, min(s + 500, n)))
+            c.execute(1, f"insert into q values {vals}")
+        c.execute(1, "alter system set dtl_min_rows = 1")
+
+        # the admin verb is config-gated
+        from oceanbase_tpu.net.rpc import RpcError
+
+        with pytest.raises(RpcError) as ei:
+            c.clients[1].call("fault.inject", where="send",
+                              action="drop", peer=3)
+        assert ei.value.kind == "PermissionError"
+        c.execute(1, "alter system set enable_fault_injection = true")
+
+        # cut every frame node 1 SENDS to node 3 (its replies to node
+        # 3's requests still flow, so node 3 never suspects the leader
+        # and no takeover muddies the scenario)
+        c.clients[1].call("fault.inject", where="send", action="drop",
+                          peer=3)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            h = c.clients[1].call("cluster.health")
+            st = {r["peer"]: r for r in h["peers"]}
+            if st[3]["state"] != "up":
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("detector never suspected node 3")
+
+        q = "select sum(v), count(*) from q where d < 500"
+        res = c.execute(1, q)
+        sel = d < 500
+        expect = [(int(v[sel].sum()), int(sel.sum()))]
+        assert c.rows(res) == expect
+        ex = c.execute(
+            1, "select pushdown_hit, fallback_parts, avoided_parts"
+               " from gv$px_exchange where mode = 'pushdown'"
+               " order by ts desc limit 1")
+        (hit, fallbacks, avoided), = c.rows(ex)
+        assert hit == 1
+        assert avoided >= 1      # pre-emptive local routing
+        assert fallbacks == 0    # no deadline was paid first
+        # parity vs the serial path
+        c.execute(1, "alter system set enable_dtl_pushdown = false")
+        assert c.rows(c.execute(1, q)) == expect
+        # gv$cluster_health through SQL mirrors the wire snapshot
+        hv = c.execute(
+            1, "select peer, state, failures from gv$cluster_health"
+               " order by peer")
+        rows = c.rows(hv)
+        assert [r[0] for r in rows] == [2, 3]
+        assert rows[1][1] in ("suspect", "down")
+        assert rows[1][2] >= 1
+        # clearing the rules heals the link; the breaker half-opens
+        c.clients[1].call("fault.clear")
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            h = c.clients[1].call("cluster.health")
+            st = {r["peer"]: r for r in h["peers"]}
+            if st[3]["state"] == "up":
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("breaker never recovered")
+    finally:
+        c.close()
