@@ -25,6 +25,7 @@ import jax
 from oceanbase_tpu.exec import diag, ops
 from oceanbase_tpu.exec.ops import AggSpec
 from oceanbase_tpu.expr import ir
+from oceanbase_tpu.server import trace as qtrace
 from oceanbase_tpu.vector.column import Relation
 
 
@@ -377,17 +378,32 @@ def execute_plan(plan: PlanNode, tables: dict[str, Relation],
     run, diag_names, monitor_names, stats = _compiled(
         key, _PlanHolder(plan, key), with_monitor)
     traces_before = stats.xla_traces
-    t0 = time.perf_counter()
-    out, diag_vals, diag_total, mon_vals = run(
-        {k: v for k, v in tables.items() if k in needed})
-    stats.executions += 1
-    if stats.xla_traces > traces_before:
-        stats.last_compile_s = time.perf_counter() - t0
-    if with_monitor:
-        # audited: opt-in plan-monitor collection materializes per-op row
-        # counts; only runs when enable_sql_plan_monitor is set
-        monitor_out.extend(  # obcheck: ok(trace.host-sync)
-            (n, int(v)) for n, v in zip(monitor_names, mon_vals))
+    # full-link trace: one HOST-side span per plan execution, closed at
+    # the result boundary below (never inside the jit-traced `run` body)
+    with qtrace.span("plan.execute", plan_hash=stats.plan_hash) as tsp:
+        t0 = time.perf_counter()
+        out, diag_vals, diag_total, mon_vals = run(
+            {k: v for k, v in tables.items() if k in needed})
+        stats.executions += 1
+        if stats.xla_traces > traces_before:
+            dt = time.perf_counter() - t0
+            stats.last_compile_s = dt
+            tsp.tags["compiled"] = 1
+            # compile-vs-execute attribution: the traced call's wall
+            # time IS the XLA trace+compile cost the shape-bucket
+            # policy amortizes (gv$plan_cache.last_compile_s)
+            qtrace.add_span("xla.compile", dt, plan_hash=stats.plan_hash)
+        if with_monitor:
+            # audited: opt-in plan-monitor collection materializes
+            # per-op row counts; only with enable_sql_plan_monitor set
+            op_rows = [  # obcheck: ok(trace.host-sync)
+                (n, int(v)) for n, v in zip(monitor_names, mon_vals)]
+            monitor_out.extend(op_rows)
+            if qtrace.current() is not None:
+                # per-operator breakdown under the plan.execute span
+                # (the plan-monitor lanes already paid the transfer)
+                for n, cnt in op_rows:
+                    qtrace.add_span("op." + n, 0.0, rows=cnt)
     if check_overflow and diag_vals:
         # audited result-boundary sync: ONE host read decides validity;
         # the per-lane detail below only materializes on the error path

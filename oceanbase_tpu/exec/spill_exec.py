@@ -56,6 +56,7 @@ from oceanbase_tpu.exec.spill import partitioned_join_spilled
 from oceanbase_tpu.expr import ir
 from oceanbase_tpu.px.dist_ops import split_aggs
 from oceanbase_tpu.px.planner import NotDistributable, split_top
+from oceanbase_tpu.server import trace as qtrace
 from oceanbase_tpu.storage.tmpfile import TempFileStore
 from oceanbase_tpu.vector import Relation, from_numpy, to_numpy
 
@@ -142,7 +143,8 @@ def execute_spilled(plan: pp.PlanNode, providers: dict, spill_dir: str,
         except NotImplementedError as e:
             raise NotDistributable(str(e)) from None
 
-    with TempFileStore(spill_dir) as store:
+    with TempFileStore(spill_dir) as store, \
+            qtrace.span("spill.execute") as tsp:
         ctx = _Ctx(store, budget_rows, chunk_rows, providers,
                    device_tables or {}, types_by_table or {}, big)
         try:
@@ -165,12 +167,20 @@ def execute_spilled(plan: pp.PlanNode, providers: dict, spill_dir: str,
                 ctx.stats.kind = "scalar"
             else:
                 ctx.stats.kind = "sort"
-            arrays, valids = _finish(ctx, batches, top)
+            # the granule streams above are lazy: _finish drives them,
+            # so the whole spill pipeline's work lands inside this span
+            # (closing at the host result boundary)
+            with qtrace.span("spill.finish"):
+                arrays, valids = _finish(ctx, batches, top)
         finally:
             ctx.snap_store()
         if any(k == "join" for k, _ in ctx.stats.ops):
             ctx.stats.kind = ("join" if ctx.stats.kind == "sort"
                               else ctx.stats.kind + "+join")
+        tsp.tags.update(kind=ctx.stats.kind, runs=ctx.stats.runs,
+                        bytes=ctx.stats.bytes,
+                        spilled_rows=ctx.stats.spilled_rows,
+                        batches=ctx.stats.batches)
         return arrays, valids, dict(ctx.dtypes), ctx.stats
 
 

@@ -53,21 +53,31 @@ class NodeDatabase:
 
         from oceanbase_tpu.px.dtl import DtlMetrics
         from oceanbase_tpu.server.monitor import (
+            AshSampler,
             PlanMonitor,
             SqlAudit,
             WaitEvents,
         )
+        from oceanbase_tpu.server.trace import TraceRegistry
         from oceanbase_tpu.server.virtual_tables import VirtualTables
 
         self._node = node
         self.root = root
         self.config = node.config
+        self.node_id = node.node_id  # stamps trace spans / gv$trace
         self.tenants = {"sys": node.tenant}
         self.workarea_history: list = []
         self.plan_monitor = PlanMonitor()
         self.audit = SqlAudit(int(self.config["sql_audit_queue_size"]))
         self.wait_events = WaitEvents()
-        self.ash = None
+        # ASH + full-link trace ring: NodeServer.start()/stop() drive
+        # the sampler lifecycle; sessions register their state slots in
+        # Session.__init__ like they do against a plain Database
+        self.ash = AshSampler(
+            interval_s=int(self.config["ash_sample_interval_ms"])
+            / 1000.0)
+        self.trace_registry = TraceRegistry(
+            int(self.config["trace_ring_spans"]))
         self.dtl_metrics = DtlMetrics()
         self.dtl = None  # DtlExchange, installed by NodeServer
         self.health = None  # HealthMonitor, installed by NodeServer
@@ -161,7 +171,7 @@ class NodeServer:
             **self.palf.handlers(),
         }
         self.server = RpcServer(host, port, handlers,
-                                faults=self.faults)
+                                faults=self.faults, node_id=node_id)
         self._sessions: dict = {}
         self._stop = threading.Event()
         self._hb: threading.Thread | None = None
@@ -299,8 +309,14 @@ class NodeServer:
             raise dtl.DtlLagging(
                 f"node {self.node_id} applied lsn "
                 f"{self.palf.replica.applied_lsn} < {applied_lsn}")
-        return dtl.execute_fragment(ts, plan, int(snapshot), int(part),
-                                    int(nparts))
+        from oceanbase_tpu.server import trace as qtrace
+
+        with qtrace.span("dtl.fragment", table=table,
+                         part=int(part)) as sp:
+            out = dtl.execute_fragment(ts, plan, int(snapshot),
+                                       int(part), int(nparts))
+            sp.tags.update(rows=out["rows"], scanned=out["scanned"])
+            return out
 
     def _h_execute(self, sql: str, consistency: str = "strong",
                    session_id: int = 0, forwarded: bool = False):
@@ -407,7 +423,8 @@ class NodeServer:
             return self._local_table_pages(table, snapshot, stats)
         chunks = []
         snap, off, nbytes = snapshot, 0, 0
-        t0 = _time.time()
+        t0 = _time.time()       # record timestamp (wall)
+        m0 = _time.monotonic()  # elapsed source (step-proof)
         while True:
             r, sent, recv = cli.call_with_size(
                 "das.scan", table=table, snapshot=snap,
@@ -434,7 +451,7 @@ class NodeServer:
                 ts=t0, table=table, mode="pull", parts=1,
                 pushdown_hit=False, bytes_shipped=nbytes,
                 rows_shipped=chunks[0]["total"],
-                elapsed_s=_time.time() - t0))
+                elapsed_s=_time.monotonic() - m0))
         return arrays, valids, chunks[0]["types"], snap
 
     def _local_table_pages(self, table: str, snapshot: int | None,
@@ -466,6 +483,8 @@ class NodeServer:
         self._hb = threading.Thread(target=self._heartbeat, daemon=True)
         self._hb.start()
         self.health.start()
+        if bool(self.config["enable_ash"]):
+            self.db.ash.start()
         if self._bootstrap:
             threading.Thread(target=self._bootstrap_elect,
                              daemon=True).start()
@@ -493,6 +512,7 @@ class NodeServer:
 
     def stop(self):
         self._stop.set()
+        self.db.ash.stop()
         self.health.stop()
         self.server.stop()
         self.palf.close()

@@ -40,6 +40,7 @@ from dataclasses import dataclass
 
 from oceanbase_tpu.net.codec import decode_msg, encode_msg
 from oceanbase_tpu.net.faults import FaultDrop, FaultReset
+from oceanbase_tpu.server import trace as qtrace
 
 _U32 = struct.Struct("<I")
 MAX_MSG = 1 << 30
@@ -185,18 +186,39 @@ class _Handler(socketserver.BaseRequestHandler):
                 except FaultReset:
                     return
             fn = self.server.handlers.get(verb)
+            # full-link trace continuation: a request carrying a trace
+            # context runs its handler under a local TraceCtx parented
+            # to the caller's rpc span; the spans ship back with the
+            # reply (success AND error — a failed handler's timing is
+            # exactly what the coordinator wants to attribute)
+            tr = msg.get("trace")
+            tctx = None
+            tsid = 0
+            if tr is not None and fn is not None:
+                try:
+                    tctx = qtrace.TraceCtx(str(tr["tid"]),
+                                           node=self.server.node_id)
+                    tsid = int(tr.get("sid", 0))
+                except (KeyError, TypeError, ValueError):
+                    tctx = None  # malformed context degrades tracing,
+                    #              never the request itself
             if fn is None:
                 resp = {"rid": rid, "ok": False,
                         "error_kind": "NoSuchMethod",
                         "error": str(verb)}
             else:
                 try:
-                    result = fn(**(msg.get("params") or {}))
+                    with qtrace.activate(tctx, tsid):
+                        with qtrace.span(str(verb), src=src):
+                            result = fn(**(msg.get("params") or {}))
                     resp = {"rid": rid, "ok": True, "result": result}
                 except Exception as e:  # noqa: BLE001 — ship to caller
                     resp = {"rid": rid, "ok": False,
                             "error_kind": type(e).__name__,
                             "error": str(e)}
+                if tctx is not None and tctx.spans:
+                    resp["spans"] = [s.to_wire()
+                                     for s in tctx.snapshot()]
             payload = encode_msg(resp)
             if faults is not None:
                 # the handler RAN by now — a reply fault is the
@@ -218,10 +240,11 @@ class RpcServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
     def __init__(self, host: str, port: int, handlers: dict,
-                 faults=None):
+                 faults=None, node_id: int = 0):
         super().__init__((host, port), _Handler)
         self.handlers = dict(handlers)
         self.faults = faults
+        self.node_id = node_id  # stamps remote trace spans
         self._thread: threading.Thread | None = None
 
     def register(self, name: str, fn):
@@ -318,8 +341,31 @@ class RpcClient:
                 "rid": next(self._rid)}
         if self.local_id is not None:
             body["src"] = self.local_id
+        # full-link tracing: one client span covers the whole call
+        # (retries included — the backoff IS the latency being traced);
+        # the context rides the frame so the peer continues the tree
+        tctx = qtrace.current()
+        tspan = None
+        if tctx is not None:
+            tspan = qtrace.begin_span(
+                tctx, "rpc." + str(method), qtrace.current_span_id(),
+                peer=self.peer_id if self.peer_id is not None else -1)
+            body["trace"] = {"tid": tctx.trace_id, "sid": tspan.span_id}
         req = encode_msg(body)
         obs = self.observer
+        try:
+            return self._call_loop(method, req, pol, deadline,
+                                   deadline_s, obs, tctx, tspan)
+        except BaseException as e:
+            if tspan is not None:
+                tspan.tags["error"] = type(e).__name__
+            raise
+        finally:
+            if tspan is not None:
+                qtrace.end_span(tctx, tspan)
+
+    def _call_loop(self, method, req, pol, deadline, deadline_s,
+                   obs, tctx, tspan):
         attempt = 0
         while True:
             sent_ok = False
@@ -356,6 +402,14 @@ class RpcClient:
                     obs.record_success(time.monotonic() - a0)
                 sent = len(req) + 4
                 recv = len(frame) + 4
+                if tspan is not None:
+                    tspan.tags["retries"] = attempt
+                    tspan.tags["bytes"] = sent + recv
+                    rspans = resp.get("spans")
+                    if rspans:
+                        # the remote half of the tree (parented under
+                        # this span via the sid we sent)
+                        qtrace.absorb(tctx, rspans)
                 if not resp.get("ok"):
                     # the handler ran and raised — a remote APPLICATION
                     # error, deterministic on resend: never retried here
@@ -369,6 +423,11 @@ class RpcClient:
                 if conn is not None:
                     conn.close()
                 now = time.monotonic()
+                if tspan is not None:
+                    # failed attempts must still attribute their retry
+                    # count — a terminal raise skips the success-path
+                    # tagging (the last failing attempt is `attempt`)
+                    tspan.tags["retries"] = attempt
                 timed_out = isinstance(e, (socket.timeout,
                                            DeadlineExceeded)) \
                     or now >= deadline

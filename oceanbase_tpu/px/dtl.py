@@ -42,6 +42,7 @@ from oceanbase_tpu.exec.ops import AggSpec
 from oceanbase_tpu.exec.plan import execute_plan
 from oceanbase_tpu.expr import ir
 from oceanbase_tpu.px.dist_ops import split_aggs
+from oceanbase_tpu.server import trace as qtrace
 from oceanbase_tpu.vector import Relation, from_numpy, to_numpy
 
 #: name of the coordinator-side relation holding the merged exchange rows
@@ -614,74 +615,101 @@ class DtlExchange:
                 remote.append((i + 1, cli))
         snap = node.tx.gts.current()
         lsn = node.palf.replica.applied_lsn
-        t0 = time.time()
+        t0 = time.time()       # record timestamp (wall)
+        m0 = time.monotonic()  # elapsed source (step-proof)
         results: list = [None] * nparts
         ship_bytes = [0] * nparts
         errors: list = [None] * nparts
+        # full-link trace: the fan-out/merge runs under one exchange
+        # span; worker threads re-activate the statement's context so
+        # per-slice spans (and the rpc spans beneath them, carrying the
+        # remote halves back) parent correctly across threads
+        tctx = qtrace.current()
+        exch = qtrace.span("dtl.exchange", table=push.table,
+                           parts=nparts)
+        with exch as xsp:
+            tparent = qtrace.current_span_id()
 
-        def run_peer(i, cli):
-            try:
-                res, sent, recv = cli.call_with_size(
-                    "dtl.execute", plan=push.encoded, table=push.table,
-                    snapshot=snap, part=i, nparts=nparts,
-                    applied_lsn=lsn)
-                results[i] = res
-                ship_bytes[i] = sent + recv
-            except Exception as e:  # noqa: BLE001 — triaged below
-                errors[i] = e
+            def run_peer(i, cli):
+                with qtrace.activate(tctx, tparent):
+                    with qtrace.span("dtl.slice", part=i,
+                                     peer=cli.peer_id):
+                        try:
+                            res, sent, recv = cli.call_with_size(
+                                "dtl.execute", plan=push.encoded,
+                                table=push.table, snapshot=snap,
+                                part=i, nparts=nparts,
+                                applied_lsn=lsn)
+                            results[i] = res
+                            ship_bytes[i] = sent + recv
+                        except Exception as e:  # noqa: BLE001 — triaged
+                            errors[i] = e
 
-        threads = [threading.Thread(target=run_peer, args=(i, cli),
-                                    daemon=True)
-                   for i, cli in remote]
-        for t in threads:
-            t.start()
-        # the coordinator's own slice — and every slice routed away
-        # from an unhealthy peer — runs locally while peers work
-        for i in avoided_parts:
-            results[i] = node._h_dtl_execute(
-                plan=push.encoded, table=push.table, snapshot=snap,
-                part=i, nparts=nparts)
-        for t in threads:
-            t.join()
-        fallbacks = 0
-        from oceanbase_tpu.net.rpc import RpcError
+            threads = [threading.Thread(target=run_peer, args=(i, cli),
+                                        daemon=True)
+                       for i, cli in remote]
+            for t in threads:
+                t.start()
+            # the coordinator's own slice — and every slice routed away
+            # from an unhealthy peer — runs locally while peers work
+            for i in avoided_parts:
+                with qtrace.span("dtl.slice", part=i, local=1):
+                    results[i] = node._h_dtl_execute(
+                        plan=push.encoded, table=push.table,
+                        snapshot=snap, part=i, nparts=nparts)
+            for t in threads:
+                t.join()
+            fallbacks = 0
+            from oceanbase_tpu.net.rpc import RpcError
 
-        for i, err in enumerate(errors):
-            if err is None:
-                continue
-            if isinstance(err, RpcError) and \
-                    err.kind == "CapacityOverflow":
-                # static budgets overflowed remotely: surface it so the
-                # session re-plans (scaled caps re-serialize next try)
-                raise CapacityOverflow(str(err))
-            if not isinstance(err, (RpcError, OSError, ConnectionError)):
-                raise err
-            # node down / lagging replica / schema not yet applied:
-            # run that slice on the local replica instead
-            results[i] = node._h_dtl_execute(
-                plan=push.encoded, table=push.table, snapshot=snap,
-                part=i, nparts=nparts)
-            fallbacks += 1
-        if node.palf.replica.applied_lsn != lsn:
-            # a commit landed while slices were executing: its version
-            # may be <= snap yet its WAL entry postdates the lag guard,
-            # so caught-up and lagging slices could DISAGREE on its
-            # visibility — a tear no single-replica read can produce.
-            # Discard the fan-out; the serial path re-reads one replica
-            # consistently.
-            return None
-        rel = merge_fragments(results)
-        out = execute_plan(push.rebuilt, {DTL_TABLE: rel},
-                           monitor_out=monitor)
-        rows_shipped = sum(r["rows"] for i, r in enumerate(results)
-                           if i > 0 and ship_bytes[i] > 0)
-        rec = DtlRecord(
-            ts=t0, table=push.table, mode="pushdown", parts=nparts,
-            pushdown_hit=True, bytes_shipped=sum(ship_bytes),
-            rows_shipped=rows_shipped, fallback_parts=fallbacks,
-            avoided_parts=len(avoided_parts) - 1,
-            elapsed_s=time.time() - t0)
+            for i, err in enumerate(errors):
+                if err is None:
+                    continue
+                if isinstance(err, RpcError) and \
+                        err.kind == "CapacityOverflow":
+                    # static budgets overflowed remotely: surface it so
+                    # the session re-plans (scaled caps re-serialize)
+                    raise CapacityOverflow(str(err))
+                if not isinstance(err,
+                                  (RpcError, OSError, ConnectionError)):
+                    raise err
+                # node down / lagging replica / schema not yet applied:
+                # run that slice on the local replica instead
+                with qtrace.span("dtl.slice", part=i, local=1,
+                                 fallback=1):
+                    results[i] = node._h_dtl_execute(
+                        plan=push.encoded, table=push.table,
+                        snapshot=snap, part=i, nparts=nparts)
+                fallbacks += 1
+            if node.palf.replica.applied_lsn != lsn:
+                # a commit landed while slices were executing: its
+                # version may be <= snap yet its WAL entry postdates the
+                # lag guard, so caught-up and lagging slices could
+                # DISAGREE on its visibility — a tear no single-replica
+                # read can produce.  Discard the fan-out; the serial
+                # path re-reads one replica consistently.
+                xsp.tags["discarded"] = 1
+                return None
+            with qtrace.span("dtl.merge", parts=nparts):
+                rel = merge_fragments(results)
+                out = execute_plan(push.rebuilt, {DTL_TABLE: rel},
+                                   monitor_out=monitor)
+            rows_shipped = sum(r["rows"] for i, r in enumerate(results)
+                               if i > 0 and ship_bytes[i] > 0)
+            elapsed = time.monotonic() - m0
+            rec = DtlRecord(
+                ts=t0, table=push.table, mode="pushdown", parts=nparts,
+                pushdown_hit=True, bytes_shipped=sum(ship_bytes),
+                rows_shipped=rows_shipped, fallback_parts=fallbacks,
+                avoided_parts=len(avoided_parts) - 1,
+                elapsed_s=elapsed)
+            xsp.tags.update(fallbacks=fallbacks,
+                            avoided=rec.avoided_parts,
+                            bytes=rec.bytes_shipped)
         self.metrics.record(rec)
+        we = getattr(getattr(node, "db", None), "wait_events", None)
+        if we is not None:
+            we.add("dtl exchange", elapsed)
         if monitor is not None:
             monitor.append((
                 f"DtlExchange(parts={nparts},fallback={fallbacks},"

@@ -615,11 +615,21 @@ def execute_plan_distributed(plan: pp.PlanNode, tables: dict,
     plan shape isn't supported (caller falls back to single-node).
     ``budget_factor`` scales exchange buffer budgets on CapacityOverflow
     retries (plan-level scale_capacities cannot reach them)."""
+    from oceanbase_tpu.server import trace as qtrace
+
     top, scalar_agg, droot = split_top(plan)
     if mesh is None:
         mesh = default_mesh(dop)
     axis = mesh.axis_names[0]
     ndev = mesh.devices.size
+    with qtrace.span("px.execute", dop=ndev, factor=budget_factor):
+        return _execute_distributed(plan, tables, mesh, axis, ndev,
+                                    budget_factor, top, scalar_agg,
+                                    droot)
+
+
+def _execute_distributed(plan, tables, mesh, axis, ndev, budget_factor,
+                         top, scalar_agg, droot) -> Relation:
 
     # partition-wise co-sharding of one scan-to-scan join's base tables
     affinity, elide = choose_affinity(droot, tables)

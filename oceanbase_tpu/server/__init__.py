@@ -3,8 +3,18 @@
 Reference analog: src/observer — ObServer boot (ob_server.cpp:228),
 multi-tenancy (omt/), the MySQL frontend, and the MTL module registry
 (src/share/rc/ob_tenant_base.h:615).
+
+``Database`` loads lazily (PEP 562): leaf modules like ``server.trace``
+are imported from net/exec hot paths and must not drag the whole server
+stack (tenant/storage/tx/palf) into their import graph.
 """
 
-from oceanbase_tpu.server.database import Database
-
 __all__ = ["Database"]
+
+
+def __getattr__(name):
+    if name == "Database":
+        from oceanbase_tpu.server.database import Database
+
+        return Database
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

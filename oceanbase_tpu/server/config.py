@@ -161,6 +161,20 @@ DEF("enable_rate_limit", False, "bool",
     "throttle writes on memstore pressure (≙ write throttling)")
 
 # diagnostics
+DEF("enable_query_trace", True, "bool",
+    "full-link statement tracing (server/trace.py): a root span per "
+    "statement, children across compile/execute/spill/exchange/rpc, "
+    "remote halves shipped back with replies (≙ ObTrace/flt -> "
+    "gv$ob_trace)")
+DEF("trace_sample_rate", 1.0, "float",
+    "fraction of statements whose trace tree is RETAINED in gv$trace "
+    "(collection stays on; slow/failed statements always retain)", _frac)
+DEF("trace_slow_threshold_s", 1.0, "float",
+    "statements at least this slow keep their trace tree even when the "
+    "sample draw said no (tail attribution must never be sampled away)",
+    _nonneg)
+DEF("trace_ring_spans", 20000, "int",
+    "bounded per-node span ring capacity behind gv$trace", _pos)
 DEF("enable_ash", True, "bool",
     "active-session-history sampling (≙ ASH)")
 DEF("ash_sample_interval_ms", 1000, "int", "ASH sampling period", _pos)

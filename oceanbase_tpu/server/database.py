@@ -28,6 +28,7 @@ from oceanbase_tpu.server.monitor import (
     WaitEvents,
 )
 from oceanbase_tpu.server.tenant import Tenant
+from oceanbase_tpu.server.trace import TraceRegistry
 from oceanbase_tpu.server.virtual_tables import VirtualTables
 
 
@@ -41,10 +42,14 @@ class Database:
         self.config = Config(persist_path=cfg_path)
         self.tenants: dict[str, Tenant] = {}
         self._session_ids = itertools.count(1)
+        self.node_id = 0  # single-process instance (NodeDatabase overrides)
 
         # observability (cluster-wide)
         self.audit = SqlAudit(int(self.config["sql_audit_queue_size"]))
         self.plan_monitor = PlanMonitor()
+        # full-link trace ring (gv$trace / SHOW TRACE; server/trace.py)
+        self.trace_registry = TraceRegistry(
+            int(self.config["trace_ring_spans"]))
         self.ash = AshSampler(
             interval_s=int(self.config["ash_sample_interval_ms"]) / 1000.0)
         self.wait_events = WaitEvents()

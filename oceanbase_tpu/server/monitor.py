@@ -14,10 +14,21 @@ Reference analogs (SURVEY §5.1/§5.5):
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
+
+
+def _tail(ring: collections.deque, n: int | None) -> list:
+    """Last ``n`` entries (``None`` = all) without materializing the
+    whole ring under the caller's lock (a 10k-deep audit ring copied
+    per gv$ read is pure waste)."""
+    k = len(ring)
+    if n is None or n >= k:
+        return list(ring)
+    return list(itertools.islice(ring, k - n, k))
 
 
 @dataclass
@@ -27,12 +38,13 @@ class AuditRecord:
     sql: str
     session_id: int
     tenant: str
-    start_ts: float
-    elapsed_s: float
+    start_ts: float            # wall clock (record timestamp)
+    elapsed_s: float           # monotonic delta (step-proof)
     rows: int
     plan_hash: str = ""
     error: str = ""
     compile_s: float = 0.0
+    trace_id: str = ""         # joins gv$trace / SHOW TRACE
 
 
 class SqlAudit:
@@ -46,9 +58,9 @@ class SqlAudit:
         with self._lock:
             self._ring.append(rec)
 
-    def recent(self, n: int = 100) -> list:
+    def recent(self, n: int | None = 100) -> list:
         with self._lock:
-            return list(self._ring)[-n:]
+            return _tail(self._ring, n)
 
     def __len__(self):
         with self._lock:
@@ -56,7 +68,11 @@ class SqlAudit:
 
 
 class PlanMonitor:
-    """Plan-level + per-operator stats for recent executions."""
+    """Plan-level + per-operator stats for recent executions.
+
+    ``record`` stamps wall time as the row's record timestamp; the
+    ``total_s`` the caller passes must be a ``time.monotonic()`` delta.
+    """
 
     def __init__(self, capacity: int = 1000):
         self._ring: collections.deque = collections.deque(maxlen=capacity)
@@ -68,7 +84,7 @@ class PlanMonitor:
 
     def recent(self, n: int = 50):
         with self._lock:
-            return list(self._ring)[-n:]
+            return _tail(self._ring, n)
 
 
 class WaitEvents:
@@ -119,16 +135,19 @@ class AshSampler:
             return {sid: dict(st) for sid, st in self._sessions.items()}
 
     def sample_once(self):
+        # wall time is the sample's RECORD timestamp (interval pacing
+        # rides the monotonic Event.wait in the sampler loop)
         now = time.time()
         with self._lock:
             for sid, st in self._sessions.items():
                 if st.get("active"):
                     self._history.append(
-                        (now, sid, st.get("sql", ""), st.get("state", "")))
+                        (now, sid, st.get("sql", ""), st.get("state", ""),
+                         st.get("trace_id", "")))
 
-    def history(self, n: int = 100):
+    def history(self, n: int | None = 100):
         with self._lock:
-            return list(self._history)[-n:]
+            return _tail(self._history, n)
 
     def start(self):
         if self._thread is not None:

@@ -34,6 +34,9 @@ class VirtualTables:
             "gv$plan_cache": self.plan_cache,
             "gv$px_exchange": self.px_exchange,
             "gv$cluster_health": self.cluster_health,
+            "gv$trace": self.trace,
+            "gv$active_session_history": self.active_session_history,
+            "gv$system_event": self.wait_events,
             "v$session_history": self.session_history,
             "v$parameters": self.parameters,
             "v$tenants": self.tenants,
@@ -54,7 +57,7 @@ class VirtualTables:
 
     # ------------------------------------------------------------------
     def sql_audit(self):
-        recs = self.db.audit.recent(10000)
+        recs = self.db.audit.recent(None)  # the whole ring
         return {
             "sql": _obj(r.sql[:200] for r in recs),
             "session_id": np.array([r.session_id for r in recs], np.int64),
@@ -64,6 +67,42 @@ class VirtualTables:
             "compile_s": np.array([r.compile_s for r in recs], np.float64),
             "rows_returned": np.array([r.rows for r in recs], np.int64),
             "error": _obj(r.error for r in recs),
+            "trace_id": _obj(r.trace_id for r in recs),
+        }
+
+    def trace(self):
+        """Completed trace spans (server/trace.py ring): one row per
+        span, the full-link tree joinable to gv$sql_audit by trace_id
+        (≙ gv$ob_trace / SHOW TRACE's backing store)."""
+        import json as _json
+
+        reg = getattr(self.db, "trace_registry", None)
+        spans = reg.recent() if reg is not None else []
+        return {
+            "trace_id": _obj(s.trace_id for s in spans),
+            "span_id": np.array([s.span_id for s in spans], np.int64),
+            "parent_span_id": np.array([s.parent_id for s in spans],
+                                       np.int64),
+            "node": np.array([s.node for s in spans], np.int64),
+            "span_name": _obj(s.name for s in spans),
+            "start_ts": np.array([s.start_ts for s in spans], np.float64),
+            "elapsed_s": np.array([s.elapsed_s for s in spans],
+                                  np.float64),
+            "tags": _obj(_json.dumps(s.tags, sort_keys=True, default=str)
+                         if s.tags else "" for s in spans),
+        }
+
+    def active_session_history(self):
+        """ASH samples with the statement's trace_id, so session history
+        joins against gv$trace (≙ gv$active_session_history)."""
+        ash = getattr(self.db, "ash", None)
+        h = ash.history(None) if ash is not None else []
+        return {
+            "sample_ts": np.array([x[0] for x in h], np.float64),
+            "session_id": np.array([x[1] for x in h], np.int64),
+            "sql": _obj(x[2][:200] for x in h),
+            "state": _obj(x[3] for x in h),
+            "trace_id": _obj(x[4] if len(x) > 4 else "" for x in h),
         }
 
     def plan_monitor(self):
@@ -175,6 +214,7 @@ class VirtualTables:
         return {
             "ts": np.array([r["ts"] for r in recs], np.float64),
             "sql": _obj(r["sql"][:200] for r in recs),
+            "plan_hash": _obj(r.get("plan_hash", "") for r in recs),
             "operation": _obj(r["kind"] for r in recs),
             "spill_runs": np.array([r["runs"] for r in recs], np.int64),
             "spill_bytes": np.array([r["bytes"] for r in recs], np.int64),

@@ -275,7 +275,7 @@ class UserStmt:
 
 @dataclass
 class ShowStmt:
-    what: str    # variables | parameters | index | processlist
+    what: str    # variables | parameters | index | processlist | trace
     table: str = ""
 
 

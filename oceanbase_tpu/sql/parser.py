@@ -223,6 +223,8 @@ class Parser:
                 return ast.ShowStmt("index", self.expect_ident())
             if self._accept_word("processlist"):
                 return ast.ShowStmt("processlist")
+            if self._accept_word("trace"):
+                return ast.ShowStmt("trace")
             self.expect_kw("tables")
             return ast.ShowTablesStmt()
         if self.at_kw("describe"):

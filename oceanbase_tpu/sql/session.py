@@ -93,7 +93,10 @@ class Session:
         self._plan_cache_total = 0
         self._last_spill = None  # SpillStats of the last spilled query
         self._tx = None  # active explicit transaction (BEGIN ... COMMIT)
-        self._ash_state = {"active": False, "sql": "", "state": "idle"}
+        self._last_trace_id = ""  # SHOW TRACE target (last kept trace)
+        self._last_compile_s = 0.0
+        self._ash_state = {"active": False, "sql": "", "state": "idle",
+                           "trace_id": ""}
         if db is not None:
             self.session_id = next(db._session_ids)
             if getattr(db, "ash", None) is not None:
@@ -122,23 +125,50 @@ class Session:
 
     # ------------------------------------------------------------------
     def execute(self, sql: str, params: list | None = None) -> Result:
-        """Parse + execute one statement, with request auditing and ASH
-        state (≙ obmp_query process + sql_audit recording)."""
+        """Parse + execute one statement, with request auditing, ASH
+        state, and a full-link trace root span (≙ obmp_query process +
+        sql_audit recording + ObTrace begin/end)."""
+        from oceanbase_tpu.server import trace as qtrace
+
         start = time.time()        # wall ts for the audit record
         t0 = time.monotonic()      # duration source (step-proof)
         err = ""
         out = None
-        self._ash_state.update(active=True, sql=sql, state="executing")
+        tctx = qtrace.start_trace(self.db)
+        self._ash_state.update(
+            active=True, sql=sql, state="executing",
+            trace_id=tctx.trace_id if tctx is not None else "")
+        self._last_compile_s = 0.0
+        self._stmt_is_show_trace = False  # set by _show_trace()
         try:
-            stmt = parse_sql(sql)
-            self._materialize_virtuals(stmt)
-            out = self.execute_stmt(stmt, params)
-            return out
+            with qtrace.activate(tctx):
+                with qtrace.span("statement", sql=sql[:200],
+                                 session=self.session_id):
+                    stmt = parse_sql(sql)
+                    self._materialize_virtuals(stmt)
+                    out = self.execute_stmt(stmt, params)
+                    return out
         except Exception as e:
             err = f"{type(e).__name__}: {e}"
             raise
         finally:
-            self._ash_state.update(active=False, state="idle")
+            elapsed = time.monotonic() - t0
+            self._ash_state.update(active=False, state="idle",
+                                   trace_id="")
+            trace_id = ""
+            if tctx is not None:
+                kept = qtrace.finish_trace(self.db, tctx, elapsed,
+                                           error=err)
+                if kept:
+                    trace_id = tctx.trace_id
+                    # SHOW TRACE reads the LAST statement's tree — a
+                    # SHOW TRACE must not clobber what it displays
+                    if not self._stmt_is_show_trace:
+                        self._last_trace_id = trace_id
+                elif not self._stmt_is_show_trace:
+                    # sampled away: SHOW TRACE must come up empty, not
+                    # silently attribute an OLDER statement's tree
+                    self._last_trace_id = ""
             if self.db is not None and \
                     getattr(self.db, "audit", None) is not None:
                 from oceanbase_tpu.server.monitor import AuditRecord
@@ -146,9 +176,11 @@ class Session:
                 self.db.audit.record(AuditRecord(
                     sql=sql, session_id=self.session_id,
                     tenant=getattr(self.tenant, "name", ""),
-                    start_ts=start, elapsed_s=time.monotonic() - t0,
+                    start_ts=start, elapsed_s=elapsed,
                     rows=out.rowcount if out is not None else 0,
                     error=err,
+                    compile_s=self._last_compile_s,
+                    trace_id=trace_id,
                 ))
 
     def _materialize_virtuals(self, stmt):
@@ -419,6 +451,8 @@ class Session:
                      "unique": np.array(uniq, dtype=np.int64),
                      "index_type": np.array(kinds, dtype=object)},
                     {}, {}, rowcount=len(names))
+            if stmt.what == "trace":
+                return self._show_trace()
             if stmt.what == "processlist":
                 rows = []
                 if self.db is not None and \
@@ -781,6 +815,62 @@ class Session:
              "key": np.array([""] * len(names), dtype=object)},
             {}, {}, rowcount=len(names))
 
+    def _show_trace(self) -> Result:
+        """SHOW TRACE: the last kept statement trace rendered as an
+        indented span tree (≙ SHOW TRACE over the flt span store).
+        Remote spans (node != coordinator) sit under the rpc span that
+        carried them.  Empty when the last statement's trace was sampled
+        away — raise trace_sample_rate (slow statements always keep)."""
+        import json as _json
+
+        self._stmt_is_show_trace = True  # don't clobber _last_trace_id
+
+        cols = ["operation", "node", "start_ts", "elapsed_ms", "tags"]
+
+        def result(rows):
+            return Result(
+                cols,
+                {"operation": np.array([r[0] for r in rows], dtype=object),
+                 "node": np.array([r[1] for r in rows], np.int64),
+                 "start_ts": np.array([r[2] for r in rows], np.float64),
+                 "elapsed_ms": np.array([r[3] for r in rows], np.float64),
+                 "tags": np.array([r[4] for r in rows], dtype=object)},
+                {}, {"operation": SqlType.string(),
+                     "tags": SqlType.string()}, rowcount=len(rows))
+
+        reg = getattr(self.db, "trace_registry", None) \
+            if self.db is not None else None
+        tid = self._last_trace_id
+        spans = reg.trace(tid) if (reg is not None and tid) else []
+        if not spans:
+            return result([])
+        by_parent: dict[int, list] = {}
+        ids = {s.span_id for s in spans}
+        for s in spans:
+            # a span whose parent was not captured here (e.g. pruned by
+            # ring wraparound) renders as a root
+            key = s.parent_id if s.parent_id in ids else 0
+            by_parent.setdefault(key, []).append(s)
+        for kids in by_parent.values():
+            kids.sort(key=lambda s: (s.start_ts, s.span_id))
+        rows: list = []
+        seen: set = set()
+
+        def walk(s, depth):
+            if s.span_id in seen:
+                return  # defensive: a malformed remote parent loop
+            seen.add(s.span_id)
+            rows.append((("  " * depth) + s.name, s.node, s.start_ts,
+                         s.elapsed_s * 1000.0,
+                         _json.dumps(s.tags, sort_keys=True, default=str)
+                         if s.tags else ""))
+            for c in by_parent.get(s.span_id, ()):
+                walk(c, depth + 1)
+
+        for root in by_parent.get(0, ()):
+            walk(root, 0)
+        return result(rows)
+
     # ------------------------------------------------------------------
     def _plan_select(self, stmt: ast.SelectStmt, params):
         seqs = self.tenant.sequences if self.tenant is not None else None
@@ -853,15 +943,19 @@ class Session:
 
     def _execute_select(self, stmt: ast.SelectStmt, params) -> Result:
         from oceanbase_tpu.exec.plan import referenced_tables
+        from oceanbase_tpu.server import trace as qtrace
 
         use_cache = (self.db is not None
                      and bool(self.db.config["enable_plan_cache"])
                      and self._ash_state.get("sql"))
-        if use_cache:
-            plan, outputs, _est = self._plan_select_cached(
-                self._ash_state["sql"], stmt, params)
-        else:
-            plan, outputs, _est = self._plan_select(stmt, params)
+        tb0 = time.monotonic()
+        with qtrace.span("compile", cached=int(bool(use_cache))):
+            if use_cache:
+                plan, outputs, _est = self._plan_select_cached(
+                    self._ash_state["sql"], stmt, params)
+            else:
+                plan, outputs, _est = self._plan_select(stmt, params)
+        self._last_compile_s = time.monotonic() - tb0
         # estimate-driven spill route (≙ the SQL memory manager deciding
         # spill from work-area estimates BEFORE execution): over-budget
         # inputs never materialize whole on device
@@ -894,7 +988,7 @@ class Session:
             monitor = []
         dop = self._px_dop()
         factor = 1
-        t0 = time.time()
+        t0 = time.monotonic()  # plan-monitor total_s (step-proof delta)
         self._last_px = False  # did the last query run through PX?
         self._last_dtl = False  # did it push down over the DTL exchange?
         # cross-node compute pushdown (px/dtl.py): ship the partial plan
@@ -902,39 +996,48 @@ class Session:
         # this node; an open transaction keeps the own-writes read path
         dtl = (getattr(self.db, "dtl", None)
                if self.db is not None and self._tx is None else None)
-        for attempt in range(int(self.variables["max_capacity_retry"]) + 1):
-            try:
-                p = plan if factor == 1 else scale_capacities(plan, factor)
-                rel = None
-                if dtl is not None:
-                    try:
-                        rel = dtl.try_execute(p, monitor=monitor)
-                    except CapacityOverflow:
-                        raise  # remote overflow: re-plan with 4x budgets
-                    except Exception:
-                        rel = None  # any exchange surprise -> serial path
-                    self._last_dtl = rel is not None
-                if rel is None and dop > 1:
-                    rel = self._try_px(p, local_tables(), dop,
-                                       factor=factor, monitor=monitor)
-                    self._last_px = rel is not None
-                if rel is None:
-                    rel = execute_plan(p, local_tables(),
-                                       monitor_out=monitor)
-                break
-            except CapacityOverflow:
-                if attempt >= int(self.variables["max_capacity_retry"]):
-                    # backstop: re-plan retries exhausted -> disk spill
-                    # tier, designating the largest input as the stream
-                    big = self._spill_candidates(plan, force_largest=True)
-                    res = (self._try_spilled(plan, outputs, big)
-                           if big else None)
-                    if res is not None:
-                        return res
-                    raise
-                factor *= 4
-                if monitor is not None:
-                    monitor.clear()
+        with qtrace.span("execute") as xsp:
+            for attempt in range(
+                    int(self.variables["max_capacity_retry"]) + 1):
+                try:
+                    p = plan if factor == 1 \
+                        else scale_capacities(plan, factor)
+                    rel = None
+                    if dtl is not None:
+                        try:
+                            rel = dtl.try_execute(p, monitor=monitor)
+                        except CapacityOverflow:
+                            raise  # remote overflow: re-plan with 4x
+                        except Exception:
+                            rel = None  # exchange surprise -> serial
+                        self._last_dtl = rel is not None
+                    if rel is None and dop > 1:
+                        rel = self._try_px(p, local_tables(), dop,
+                                           factor=factor,
+                                           monitor=monitor)
+                        self._last_px = rel is not None
+                    if rel is None:
+                        rel = execute_plan(p, local_tables(),
+                                           monitor_out=monitor)
+                    break
+                except CapacityOverflow:
+                    if attempt >= \
+                            int(self.variables["max_capacity_retry"]):
+                        # backstop: re-plan retries exhausted -> disk
+                        # spill tier, largest input as the stream
+                        big = self._spill_candidates(
+                            plan, force_largest=True)
+                        res = (self._try_spilled(plan, outputs, big)
+                               if big else None)
+                        if res is not None:
+                            return res
+                        raise
+                    factor *= 4
+                    if monitor is not None:
+                        monitor.clear()
+            xsp.tags.update(attempts=attempt + 1, factor=factor,
+                            dtl=int(self._last_dtl),
+                            px=int(self._last_px))
         if factor > 1 and use_cache:
             # evolve the cached plan: a plan bound against a smaller
             # table keeps overflowing its stale capacity budgets, which
@@ -948,7 +1051,7 @@ class Session:
         if monitor is not None:
             self.db.plan_monitor.record(
                 plan.fingerprint()[:64] if hasattr(plan, "fingerprint")
-                else "", monitor, time.time() - t0)
+                else "", monitor, time.monotonic() - t0)
         return self._materialize(rel, outputs)
 
     # -- ANN top-k access path (vector index) ---------------------------
@@ -1311,7 +1414,8 @@ class Session:
                 else None)
         sdir = os.path.join(root or "/tmp/obtpu", "tmpfile",
                             f"q{uuid.uuid4().hex[:10]}")
-        t0 = time.time()
+        t0 = time.time()       # record timestamp (wall)
+        m0 = time.monotonic()  # elapsed source (step-proof)
         try:
             arrays, valids, dtypes, stats = spill_exec.execute_spilled(
                 plan, providers, sdir,
@@ -1322,11 +1426,19 @@ class Session:
             # (count_distinct) — fall back to the in-memory engine
             return None
         self._last_spill = stats
+        elapsed = time.monotonic() - m0
+        try:
+            plan_hash = plan.fingerprint()[:64]
+        except Exception:
+            plan_hash = ""
         self.db.workarea_history.append({
             "ts": t0, "sql": self._ash_state.get("sql", ""),
+            "plan_hash": plan_hash,
             "kind": stats.kind, "runs": stats.runs,
             "bytes": stats.bytes, "spilled_rows": stats.spilled_rows,
-            "batches": stats.batches, "elapsed_s": time.time() - t0})
+            "batches": stats.batches, "elapsed_s": elapsed})
+        if getattr(self.db, "wait_events", None) is not None:
+            self.db.wait_events.add("spill io", elapsed)
         return self._materialize_host(arrays, valids, dtypes, outputs)
 
     def _catalog_provider(self, name: str):

@@ -253,6 +253,28 @@ def main():
             "queries": len(lat), "hung": hung,
             "retries": sum(r["retries"] for r in h["peers"])}
 
+        if "--trace" in sys.argv:
+            # full-link tracing under faults: the fault-hit queries'
+            # trees must NAME the dropped/retried verb — rpc.dtl.execute
+            # spans with a retry count or error tag, and per-slice
+            # fallback spans for the slices re-run locally
+            spans = rows_of(sql(
+                "select span_name, tags from gv$trace"))
+            rpc_spans = [json.loads(t) if t else {}
+                         for n, t in spans if n == "rpc.dtl.execute"]
+            retried = [t for t in rpc_spans
+                       if int(t.get("retries", 0)) > 0 or "error" in t]
+            fallbacks = sum(
+                1 for n, t in spans
+                if n == "dtl.slice" and t and
+                json.loads(t).get("fallback"))
+            out["trace"] = {
+                "rpc_dtl_spans": len(rpc_spans),
+                "retried_or_failed": len(retried),
+                "fallback_slices": fallbacks,
+                "verb_named": bool(retried or fallbacks),
+            }
+
         # ---- scenario 2: partition the leader from node 2 ----------
         for a, b in ((1, 2), (2, 1)):
             for where in ("send", "recv"):

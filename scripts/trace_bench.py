@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Trace bench: full-link tracing overhead + fault attribution.
+
+Two halves, one JSON line:
+
+1. **Overhead** — the TPC-H slice (q6 + q1) on an in-process Database,
+   timed with tracing OFF (``enable_query_trace=false``) vs ON at
+   ``trace_sample_rate=1.0``.  Every statement collects its full span
+   tree in the ON runs; the contract is <= 2% elapsed overhead.
+
+2. **Attribution** — a real 3-node cluster runs Q6 through the DTL
+   exchange with an injected ``fault.inject`` delay on ``dtl.execute``
+   toward one peer.  The query's gv$sql_audit row must join one
+   gv$trace tree by trace_id whose SLOWEST span is the injected verb
+   (``rpc.dtl.execute``) toward the injected peer.
+
+    python scripts/trace_bench.py                 # both halves
+    TRACE_BENCH_SKIP_CLUSTER=1 python scripts/trace_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+QUERIES = {
+    "q6": ("select sum(l_extendedprice * l_discount) from lineitem"
+           " where l_shipdate >= 8766 and l_shipdate < 9131"
+           " and l_discount >= 5 and l_discount <= 7"
+           " and l_quantity < 24"),
+    "q1": ("select l_returnflag, l_linestatus, sum(l_quantity),"
+           " sum(l_extendedprice), avg(l_discount), count(*)"
+           " from lineitem where l_shipdate <= 10000"
+           " group by l_returnflag, l_linestatus"
+           " order by l_returnflag, l_linestatus"),
+}
+
+
+def _gen(n_rows: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    return {
+        "l_quantity": rng.integers(1, 50, n_rows),
+        "l_extendedprice": rng.integers(1000, 100000, n_rows),
+        "l_discount": rng.integers(0, 10, n_rows),
+        "l_shipdate": rng.integers(8766, 10227, n_rows),
+        "l_returnflag": rng.integers(0, 3, n_rows),
+        "l_linestatus": rng.integers(0, 2, n_rows),
+    }
+
+
+def _load(sess, cols, n_rows):
+    sess.execute(
+        "create table lineitem (l_id int primary key, l_quantity int,"
+        " l_extendedprice int, l_discount int, l_shipdate int,"
+        " l_returnflag int, l_linestatus int)")
+    for s in range(0, n_rows, 2000):
+        e = min(s + 2000, n_rows)
+        vals = ", ".join(
+            f"({i}, {cols['l_quantity'][i]}, {cols['l_extendedprice'][i]},"
+            f" {cols['l_discount'][i]}, {cols['l_shipdate'][i]},"
+            f" {cols['l_returnflag'][i]}, {cols['l_linestatus'][i]})"
+            for i in range(s, e))
+        sess.execute(f"insert into lineitem values {vals}")
+
+
+def _time_queries(sess, repeats: int) -> float:
+    t0 = time.monotonic()
+    for _ in range(repeats):
+        for q in QUERIES.values():
+            sess.execute(q)
+    return time.monotonic() - t0
+
+
+def bench_overhead(n_rows: int, repeats: int) -> dict:
+    from oceanbase_tpu.server import Database
+
+    root = tempfile.mkdtemp(prefix="tracebench_")
+    try:
+        db = Database(root)
+        s = db.session()
+        _load(s, _gen(n_rows), n_rows)
+        # parity guard: tracing must never change results
+        s.execute("alter system set enable_query_trace = true")
+        on_rows = {k: s.execute(q).rows() for k, q in QUERIES.items()}
+        s.execute("alter system set enable_query_trace = false")
+        off_rows = {k: s.execute(q).rows() for k, q in QUERIES.items()}
+        assert on_rows == off_rows, "tracing changed results"
+        # warm the jit caches so the measurement sees steady state
+        _time_queries(s, 3)
+        # interleave off/on blocks in ALTERNATING order so warmth and
+        # drift hit both sides equally
+        s.execute("alter system set trace_sample_rate = 1.0")
+        off_s = on_s = 0.0
+        blocks = 4
+        per_block = max(repeats // blocks, 1)
+        for b in range(blocks):
+            order = ("false", "true") if b % 2 == 0 else ("true", "false")
+            for mode in order:
+                s.execute(f"alter system set enable_query_trace = {mode}")
+                dt = _time_queries(s, per_block)
+                if mode == "true":
+                    on_s += dt
+                else:
+                    off_s += dt
+        n_spans = len(db.trace_registry.recent(100000))
+        db.close()
+        return {
+            "rows": n_rows, "repeats": per_block * blocks,
+            "off_s": round(off_s, 4), "on_s": round(on_s, 4),
+            "overhead_pct": round((on_s - off_s) / off_s * 100.0, 3),
+            "spans_in_ring": n_spans,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_attribution(n_rows: int, seed: int = 7) -> dict:
+    """3-node cluster, delay injected on dtl.execute toward peer 2: the
+    slowest span of Q6's trace must name the verb and the peer."""
+    from chaos_bench import boot_cluster, rows_of, wait_converged
+
+    root = tempfile.mkdtemp(prefix="tracebench_cl_")
+    procs = {}
+    try:
+        procs, clients = boot_cluster(root, seed=seed)
+        c1 = clients[1]
+
+        def sql(text):
+            last = None
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                try:
+                    return c1.call("sql.execute", sql=text)
+                except Exception as e:  # noqa: BLE001 — retried
+                    last = e
+                    time.sleep(0.3)
+            raise TimeoutError(f"query never succeeded: {last}")
+
+        cols = _gen(n_rows)
+        sql("create table lineitem (l_id int primary key,"
+            " l_quantity int, l_extendedprice int, l_discount int,"
+            " l_shipdate int, l_returnflag int, l_linestatus int)")
+        for s in range(0, n_rows, 1000):
+            e = min(s + 1000, n_rows)
+            vals = ", ".join(
+                f"({i}, {cols['l_quantity'][i]},"
+                f" {cols['l_extendedprice'][i]},"
+                f" {cols['l_discount'][i]}, {cols['l_shipdate'][i]},"
+                f" {cols['l_returnflag'][i]}, {cols['l_linestatus'][i]})"
+                for i in range(s, e))
+            sql(f"insert into lineitem values {vals}")
+        wait_converged(clients, "lineitem", n_rows)
+        sql("alter system set dtl_min_rows = 1")
+        baseline = rows_of(sql(QUERIES["q6"]))
+        sql(QUERIES["q6"])  # warm the pushdown path
+
+        delay_ms = 400.0
+        c1.call("fault.inject", where="send", action="delay",
+                verb="dtl.execute", peer=2, delay_ms=delay_ms)
+        t0 = time.monotonic()
+        faulted = rows_of(sql(QUERIES["q6"]))
+        q6_s = time.monotonic() - t0
+        c1.call("fault.clear")
+        assert faulted == baseline, "fault changed results"
+
+        # join the audit row to its trace by trace_id
+        audit = rows_of(sql(
+            "select trace_id, sql, start_ts from gv$sql_audit"))
+        trace_id = next(
+            tid for tid, q, _ts in sorted(audit, key=lambda r: -r[2])
+            if tid and q.startswith("select sum(l_extendedprice"))
+        spans = rows_of(sql(
+            f"select span_name, node, elapsed_s, tags from gv$trace"
+            f" where trace_id = '{trace_id}'"
+            f" order by elapsed_s desc"))
+        # the root/statement/execute chain contains the delay too; the
+        # slowest LEAF-side span below them must be the injected verb
+        chain = {"statement", "execute", "dtl.exchange", "dtl.slice"}
+        slowest = next(s for s in spans if s[0] not in chain)
+        tags = json.loads(slowest[3]) if slowest[3] else {}
+        ok = (slowest[0] == "rpc.dtl.execute"
+              and int(tags.get("peer", -1)) == 2
+              and float(slowest[2]) >= delay_ms / 1000.0)
+        return {
+            "rows": n_rows, "delay_ms": delay_ms,
+            "q6_under_fault_s": round(q6_s, 3),
+            "trace_id": trace_id, "trace_spans": len(spans),
+            "slowest_span": slowest[0],
+            "slowest_span_tags": tags,
+            "slowest_elapsed_s": round(float(slowest[2]), 3),
+            "attribution_ok": bool(ok), "parity": True,
+        }
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main():
+    n_rows = int(os.environ.get("BENCH_ROWS", "100000"))
+    repeats = int(os.environ.get("BENCH_REPEATS", "40"))
+    out = {"metric": "trace_bench"}
+    out["overhead"] = bench_overhead(n_rows, repeats)
+    if not os.environ.get("TRACE_BENCH_SKIP_CLUSTER"):
+        out["attribution"] = bench_attribution(
+            int(os.environ.get("BENCH_CLUSTER_ROWS", "20000")))
+        out["ok"] = bool(out["attribution"]["attribution_ok"]
+                         and out["overhead"]["overhead_pct"] <= 2.0)
+    else:
+        out["ok"] = out["overhead"]["overhead_pct"] <= 2.0
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
