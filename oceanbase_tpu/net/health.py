@@ -51,7 +51,8 @@ class PeerHealth:
     failures: int = 0
     retries: int = 0
     deadline_exceeded: int = 0
-    last_change_ts: float = 0.0  # monotonic, 0 = never
+    last_change_ts: float = 0.0   # monotonic, 0 = never
+    last_transition_ts: float = 0.0  # wall clock of the last state flip
 
     def row(self) -> dict:
         return {"peer": self.peer, "state": self.state,
@@ -60,7 +61,8 @@ class PeerHealth:
                 "breaker_opens": self.breaker_opens,
                 "successes": self.successes, "failures": self.failures,
                 "retries": self.retries,
-                "deadline_exceeded": self.deadline_exceeded}
+                "deadline_exceeded": self.deadline_exceeded,
+                "last_transition_ts": self.last_transition_ts}
 
 
 class _PeerObserver:
@@ -123,8 +125,13 @@ class HealthMonitor:
                 self.rtt_alpha * ms
                 + (1.0 - self.rtt_alpha) * st.rtt_ewma_ms)
             if st.state != UP:
+                # breaker resets on the FIRST success: a recovered peer
+                # flips down→up within one heartbeat interval, so DTL
+                # routing (and gv$px_exchange avoided_parts) stop
+                # steering around it promptly
                 st.state = UP
                 st.last_change_ts = time.monotonic()
+                st.last_transition_ts = time.time()
 
     def record_failure(self, peer: int):
         fire = None
@@ -145,6 +152,7 @@ class HealthMonitor:
                 went_down = new == DOWN
                 st.state = new
                 st.last_change_ts = time.monotonic()
+                st.last_transition_ts = time.time()
                 if went_down and self.on_down is not None:
                     fire = self.on_down
         if fire is not None:

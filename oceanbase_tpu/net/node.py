@@ -39,7 +39,7 @@ _DDL_KINDS = {"create_view", "drop_view",
               "drop_aux_index"}
 _WRITE_PREFIXES = ("insert", "update", "delete", "replace", "create",
                    "drop", "alter", "truncate", "load", "begin",
-                   "commit", "rollback")
+                   "commit", "rollback", "xa")
 SCAN_CHUNK_ROWS = 65536
 
 
@@ -115,6 +115,7 @@ class NodeServer:
         from oceanbase_tpu.server.tenant import Tenant
 
         self.node_id = node_id
+        self.root = root
         self.peer_addrs = dict(peers)
         self.config = Config(persist_path=(
             os.path.join(root, "config.json") if root else None))
@@ -127,13 +128,34 @@ class NodeServer:
                                      faults=self.faults, pool_size=pool)
                       for pid, (h, p) in peers.items()}
         self._apply_lock = threading.RLock()
-        self._replay_pending: dict = {}
 
-        wal_dir = os.path.join(root, "wal") if root else None
-        self.palf = NetPalf(node_id, self.peers, log_dir=wal_dir,
-                            apply_cb=self._apply_entry,
-                            lease_ms=lease_ms)
-        self.tenant = Tenant("sys", root, self.config, wal=self.palf)
+        # rebuild tier: a WIPED node (no manifest, no slog, no WAL)
+        # bootstraps from a peer's checkpoint + segments + WAL BEFORE
+        # the engine opens, then boots through the ordinary restart
+        # path (≙ ob_storage_ha_dag replica rebuild).  The whole boot
+        # runs under one trace so gv$trace shows the recovery tree
+        # (rebuild.fetch / recovery.replay / recovery.restore_prepared).
+        import uuid
+
+        from oceanbase_tpu.net import rebuild as _rebuild
+        from oceanbase_tpu.server import trace as qtrace
+        from oceanbase_tpu.storage.recovery import RecoveryState
+
+        self.recovery = RecoveryState(node_id)
+        boot_trace = qtrace.TraceCtx(
+            f"boot-{node_id}-{uuid.uuid4().hex[:8]}", node=node_id)
+        with qtrace.activate(boot_trace):
+            if root and bool(self.config["enable_auto_rebuild"]):
+                _rebuild.maybe_rebuild(
+                    root, node_id, self.peers, recovery=self.recovery,
+                    chunk_bytes=int(self.config["rebuild_chunk_bytes"]))
+
+            wal_dir = os.path.join(root, "wal") if root else None
+            self.palf = NetPalf(node_id, self.peers, log_dir=wal_dir,
+                                apply_cb=self._apply_entry,
+                                lease_ms=lease_ms)
+            self.tenant = Tenant("sys", root, self.config,
+                                 wal=self.palf, recovery=self.recovery)
         self.engine = self.tenant.engine
         self.tx = self.tenant.tx
         self.catalog = self.tenant.catalog
@@ -141,6 +163,8 @@ class NodeServer:
         # in _apply_entry; physical segment ops stay node-local)
         self.engine.ddl_wal_cb = self._on_local_ddl
         self.db = NodeDatabase(self, root)
+        if boot_trace.spans:
+            self.db.trace_registry.add(boot_trace.snapshot())
         from oceanbase_tpu.px.dtl import DtlExchange
 
         self.db.dtl = DtlExchange(self, self.db.dtl_metrics)
@@ -158,6 +182,7 @@ class NodeServer:
             cli.observer = self.health.observer(pid)
         self.db.health = self.health
 
+        self.rebuild = _rebuild.RebuildServer(self)
         handlers = {
             "ping": lambda: "pong",
             "das.scan": self._h_scan,
@@ -166,8 +191,10 @@ class NodeServer:
             "sql.execute": self._h_execute,
             "node.state": self._h_state,
             "cluster.health": self._h_health,
+            "recovery.state": self._h_recovery,
             "fault.inject": self._h_fault_inject,
             "fault.clear": self._h_fault_clear,
+            **self.rebuild.handlers(),
             **self.palf.handlers(),
         }
         self.server = RpcServer(host, port, handlers,
@@ -175,6 +202,7 @@ class NodeServer:
         self._sessions: dict = {}
         self._stop = threading.Event()
         self._hb: threading.Thread | None = None
+        self._ckpt: threading.Thread | None = None
         self._bootstrap = bootstrap
 
     # ------------------------------------------------------------------
@@ -190,10 +218,12 @@ class NodeServer:
                 rec = json.loads(entry.payload.decode())
             except Exception:
                 return
-            from oceanbase_tpu.tx.service import TransService
-
-            max_ts = TransService.replay([entry], self.engine,
-                                         pending=self._replay_pending)
+            # the tx service's PERSISTENT replay buffers: boot replay
+            # leftovers (e.g. a prepared XA branch's redo) stay visible
+            # to a commit record arriving later through catch-up, and a
+            # replayed prepare record registers the branch for XA
+            # RECOVER on this node too (durable XA across failover)
+            max_ts = self.tx.apply_replay([entry])
             if rec.get("op") == "ddl":
                 self.catalog.schema_version += 1
             if max_ts:
@@ -223,6 +253,19 @@ class NodeServer:
         gv$cluster_health)."""
         return {"node_id": self.node_id,
                 "peers": self.health.snapshot()}
+
+    def _h_recovery(self):
+        """Recovery progress (the wire face of gv$recovery): boot
+        replay / rebuild / checkpoint events plus the live catch-up
+        lag and the prepared XA branches this node can recover."""
+        r = self.palf.replica
+        xids = self.tx.recoverable_xids()
+        return {"node_id": self.node_id,
+                "applied_lsn": r.applied_lsn,
+                "committed_lsn": r.committed_lsn,
+                "replay_point": self.engine.meta.get("wal_lsn", 0),
+                "prepared_xids": xids,
+                "events": self.recovery.rows()}
 
     def _h_fault_inject(self, where: str, action: str, verb=None,
                         peer=None, prob: float = 1.0, nth=None,
@@ -482,6 +525,9 @@ class NodeServer:
         self.server.start()
         self._hb = threading.Thread(target=self._heartbeat, daemon=True)
         self._hb.start()
+        self._ckpt = threading.Thread(target=self._checkpoint_loop,
+                                      daemon=True)
+        self._ckpt.start()
         self.health.start()
         if bool(self.config["enable_ash"]):
             self.db.ash.start()
@@ -509,6 +555,22 @@ class NodeServer:
                     self.palf.tick()
             except Exception:
                 pass
+
+    def _checkpoint_loop(self):
+        """Periodic replay-point advance (≙ the tenant checkpoint slog
+        recycler): restart replay cost stays O(WAL tail since the last
+        checkpoint), not O(history).  Skips quiet intervals — a
+        checkpoint only runs once the local APPLY point is at least
+        ``checkpoint_lag_entries`` past the persisted replay point."""
+        while not self._stop.wait(
+                float(self.config["log_checkpoint_interval_s"])):
+            try:
+                lag = (self.palf.replica.applied_lsn
+                       - int(self.engine.meta.get("wal_lsn", 0)))
+                if lag >= int(self.config["checkpoint_lag_entries"]):
+                    self.tenant.checkpoint()
+            except Exception:
+                pass  # transient flush failure: retry next interval
 
     def stop(self):
         self._stop.set()

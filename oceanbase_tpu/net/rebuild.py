@@ -1,0 +1,290 @@
+"""Wiped-replica rebuild: bootstrap a node from a peer's checkpoint +
+WAL, then let ordinary catch-up finish the job.
+
+Reference analog: the replica rebuild / migration dag-nets
+(src/storage/high_availability/ob_storage_ha_dag.h,
+ob_ls_migration_handler) — a new or wiped replica copies a consistent
+baseline (tablet metas + macro blocks ≙ manifest + segment files) from a
+source replica, then replays the log tail.
+
+Protocol (server side registered on every NodeServer):
+
+    rebuild.fetch_meta()
+        -> {"node_id", "wal_lsn", "role", "manifest": bytes,
+            "slog": bytes, "files": [{"name", "size",
+            "kind": "data" | "wal"}]}
+        The peer checkpoints first and ships the manifest + slog BYTES
+        inline (atomic with the file list — a checkpoint racing the
+        chunked downloads cannot hand the client a NEWER manifest whose
+        segments were never listed).  The listed segment files are
+        immutable once written and never deleted; the WAL file is
+        append-only — a chunk boundary racing an append at worst tears
+        the final entry, which the torn-tail scan at boot truncates and
+        catch-up re-ships.
+
+    rebuild.fetch_segments(name, offset, limit)
+        -> {"data": bytes, "eof": bool, "size": int}
+        One chunk of one baseline file (byte-accounted, idempotent —
+        the retry budget in net/rpc.py::POLICIES applies).
+
+Client side (``maybe_rebuild``) runs BEFORE the tenant boots: files
+download into ``<root>/.rebuild_tmp`` and install in crash-safe order
+(segments → slog → WAL → manifest last), so an interrupted rebuild
+either restarts from scratch or boots from a WAL-only prefix that full
+replay reconstructs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import time
+
+from oceanbase_tpu.server import trace as qtrace
+
+log = logging.getLogger(__name__)
+
+#: default chunk budget per rebuild.fetch_segments call (overridable via
+#: the rebuild_chunk_bytes knob); well under the 1 GiB frame cap
+DEFAULT_CHUNK_BYTES = 4 << 20
+
+#: generic name the wire uses for the peer's replica WAL file — each
+#: side maps it to its own replica id's path
+WAL_NAME = "wal/replica.log"
+
+
+# ---------------------------------------------------------------------------
+# server side
+# ---------------------------------------------------------------------------
+
+
+class RebuildServer:
+    """The peer half: serves its own root dir as a rebuild baseline."""
+
+    def __init__(self, node):
+        self.node = node
+
+    def handlers(self) -> dict:
+        return {"rebuild.fetch_meta": self.fetch_meta,
+                "rebuild.fetch_segments": self.fetch_segments}
+
+    def _wal_path(self) -> str:
+        return os.path.join(self.node.root, "wal",
+                            f"replica_{self.node.node_id}.log")
+
+    def _data_dir(self) -> str:
+        return os.path.join(self.node.root, "data")
+
+    def fetch_meta(self):
+        """Checkpoint, then describe the baseline a wiped peer needs.
+        Checkpointing first bounds the WAL tail the rebuilt node must
+        replay; the manifest + slog ship INLINE so they are atomic with
+        the segment list (a later checkpoint racing the chunked segment
+        downloads must not hand the client a newer manifest referencing
+        segments it never listed — boot would silently skip them)."""
+        self.node.tenant.checkpoint()
+        ddir = self._data_dir()
+
+        def read(path):
+            try:
+                with open(path, "rb") as f:
+                    return f.read()
+            except OSError:
+                return b""
+
+        manifest = read(os.path.join(ddir, "manifest.json"))
+        slog = read(os.path.join(ddir, "slog.jsonl"))
+        files = []
+        for base, _dirs, names in os.walk(ddir):
+            for n in sorted(names):
+                if n.endswith(".tmp") or \
+                        n in ("manifest.json", "slog.jsonl"):
+                    continue
+                p = os.path.join(base, n)
+                rel = os.path.join("data", os.path.relpath(p, ddir))
+                files.append({"name": rel, "size": os.path.getsize(p),
+                              "kind": "data"})
+        wal = self._wal_path()
+        if os.path.exists(wal):
+            files.append({"name": WAL_NAME,
+                          "size": os.path.getsize(wal), "kind": "wal"})
+        return {"node_id": self.node.node_id,
+                "wal_lsn": self.node.engine.meta.get("wal_lsn", 0),
+                "role": self.node.palf.replica.role,
+                "manifest": manifest, "slog": slog,
+                "files": files}
+
+    def _resolve(self, name: str) -> str:
+        """Map a wire file name to a real path, refusing traversal.
+        Normalize BEFORE the prefix check: 'data/../config.json' would
+        otherwise pass both a raw startswith('data/') test and the
+        root containment test while escaping the data dir."""
+        if name == WAL_NAME:
+            return self._wal_path()
+        norm = os.path.normpath(str(name))
+        if os.path.isabs(norm) or \
+                not norm.startswith("data" + os.sep) or \
+                ".." in norm.split(os.sep):
+            raise PermissionError(f"rebuild: refusing path {name!r}")
+        root = os.path.abspath(self.node.root)
+        p = os.path.abspath(os.path.join(root, norm))
+        if not p.startswith(root + os.sep):
+            raise PermissionError(f"rebuild: refusing path {name!r}")
+        return p
+
+    def fetch_segments(self, name: str, offset: int = 0,
+                       limit: int = DEFAULT_CHUNK_BYTES):
+        limit = max(1, min(int(limit), DEFAULT_CHUNK_BYTES * 4))
+        p = self._resolve(str(name))
+        size = os.path.getsize(p)
+        with open(p, "rb") as f:
+            f.seek(int(offset))
+            data = f.read(limit)
+        return {"data": data, "size": size,
+                "eof": int(offset) + len(data) >= size}
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+
+
+def needs_rebuild(root: str, node_id: int) -> bool:
+    """A node needs a rebuild when it has NO local recovery sources: no
+    manifest, no slog, and no (non-trivial) replica WAL.  A partially
+    wiped node (WAL kept) boots by full replay instead."""
+    data = os.path.join(root, "data")
+    if os.path.exists(os.path.join(data, "manifest.json")):
+        return False
+    slog = os.path.join(data, "slog.jsonl")
+    if os.path.exists(slog) and os.path.getsize(slog) > 0:
+        return False
+    wal = os.path.join(root, "wal", f"replica_{node_id}.log")
+    # magic-only file == empty log
+    return not (os.path.exists(wal) and os.path.getsize(wal) > 8)
+
+
+def _pick_source(peers: dict) -> tuple[int, object, dict] | None:
+    """Probe peers; prefer the leader, else the longest committed log.
+    Returns (peer_id, client, state) or None when no peer has data."""
+    from oceanbase_tpu.net.rpc import RpcError
+
+    best = None
+    for pid, cli in sorted(peers.items()):
+        try:
+            st = cli.call("palf.state", _deadline_s=2.0)
+        except (OSError, RpcError):
+            # unreachable OR mid-boot/handler error: try the next peer
+            continue
+        committed = int(st.get("committed_lsn", 0))
+        if committed <= 0:
+            continue
+        rank = (1 if st.get("role") == "leader" else 0, committed)
+        if best is None or rank > best[0]:
+            best = (rank, pid, cli, st)
+    return None if best is None else best[1:]
+
+
+def rebuild_from_peer(root: str, node_id: int, peers: dict,
+                      recovery=None,
+                      chunk_bytes: int = DEFAULT_CHUNK_BYTES):
+    """Stream a peer's checkpoint + segments + WAL into ``root``.
+    Returns a stats dict, or None when no peer has anything to offer
+    (fresh-cluster boot)."""
+    src = _pick_source(peers)
+    if src is None:
+        return None
+    pid, cli, _st = src
+    t0 = time.monotonic()
+    with qtrace.span("rebuild.fetch", peer=pid) as sp:
+        meta = cli.call("rebuild.fetch_meta")
+        tmp = os.path.join(root, ".rebuild_tmp")
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp, exist_ok=True)
+        nbytes = 0
+        for f in meta["files"]:
+            dst = os.path.join(tmp, f["name"])
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            with open(dst, "wb") as out:
+                off = 0
+                while True:
+                    r = cli.call("rebuild.fetch_segments",
+                                 name=f["name"], offset=off,
+                                 limit=int(chunk_bytes))
+                    out.write(r["data"])
+                    off += len(r["data"])
+                    nbytes += len(r["data"])
+                    if r["eof"] or not r["data"]:
+                        break
+        # manifest + slog came inline with fetch_meta: the point-in-time
+        # pair that matches the segment list we just streamed
+        os.makedirs(os.path.join(tmp, "data"), exist_ok=True)
+        for rel, blob in (("slog.jsonl", meta.get("slog", b"")),
+                          ("manifest.json", meta.get("manifest", b""))):
+            if blob:
+                with open(os.path.join(tmp, "data", rel), "wb") as out:
+                    out.write(blob)
+                nbytes += len(blob)
+        _install(root, node_id, tmp, meta["files"])
+        shutil.rmtree(tmp, ignore_errors=True)
+        sp.tags.update(files=len(meta["files"]), bytes=nbytes)
+    stats = {"peer": pid, "files": len(meta["files"]), "bytes": nbytes,
+             "wal_lsn": int(meta.get("wal_lsn", 0)),
+             "elapsed_s": time.monotonic() - t0}
+    log.warning("node %d: rebuilt from peer %d — %d files, %d bytes, "
+                "checkpoint replay point %d", node_id, pid,
+                stats["files"], nbytes, stats["wal_lsn"])
+    if recovery is not None:
+        recovery.record("rebuild", peer=pid, nbytes=nbytes,
+                        entries=len(meta["files"]),
+                        wal_end_lsn=stats["wal_lsn"],
+                        elapsed_s=stats["elapsed_s"],
+                        note=f"files={stats['files']}")
+    return stats
+
+
+def _install(root: str, node_id: int, tmp: str, files: list[dict]):
+    """Move the downloaded baseline into place, manifest LAST: an
+    interrupted install leaves either nothing (rebuild restarts) or a
+    WAL-only prefix (full replay reconstructs it)."""
+
+    def move(rel_src: str, rel_dst: str):
+        src = os.path.join(tmp, rel_src)
+        if not os.path.exists(src):
+            return
+        dst = os.path.join(root, rel_dst)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.replace(src, dst)
+
+    manifest = os.path.join("data", "manifest.json")
+    slog = os.path.join("data", "slog.jsonl")
+    for f in files:
+        if f["kind"] == "data" and f["name"] != manifest:
+            move(f["name"], f["name"])
+    move(slog, slog)
+    move(WAL_NAME, os.path.join("wal", f"replica_{node_id}.log"))
+    move(manifest, manifest)
+
+
+def maybe_rebuild(root: str, node_id: int, peers: dict, recovery=None,
+                  chunk_bytes: int = DEFAULT_CHUNK_BYTES):
+    """The boot hook: rebuild iff this node is wiped AND a peer has
+    data.  Runs BEFORE the engine/WAL open, so a rebuilt node boots
+    through the ordinary restart path (checkpoint + WAL tail replay)."""
+    from oceanbase_tpu.net.rpc import RpcError
+
+    if not root or not needs_rebuild(root, node_id):
+        return None
+    try:
+        return rebuild_from_peer(root, node_id, peers,
+                                 recovery=recovery,
+                                 chunk_bytes=chunk_bytes)
+    except (OSError, RpcError) as e:
+        # a source dying mid-rebuild leaves only .rebuild_tmp behind:
+        # boot continues empty and ordinary catch-up replays the log
+        log.warning("node %d: rebuild aborted (%s); booting empty",
+                    node_id, e)
+        shutil.rmtree(os.path.join(root, ".rebuild_tmp"),
+                      ignore_errors=True)
+        return None

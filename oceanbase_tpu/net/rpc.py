@@ -109,6 +109,13 @@ POLICIES: dict[str, VerbPolicy] = {
     "fault.inject": VerbPolicy(5.0, False),
     "fault.clear":  VerbPolicy(5.0, True, 2, 0.02, 0.20),
     "cluster.health": VerbPolicy(2.0, True, 2, 0.02, 0.20),
+    "recovery.state": VerbPolicy(2.0, True, 2, 0.02, 0.20),
+    # rebuild plane (net/rebuild.py): fetch_meta re-checkpoints on
+    # resend (harmless — checkpoints are idempotent w.r.t. state) and
+    # fetch_segments is a pure ranged read; both carry a retry budget
+    # so a wiped node's bootstrap survives transient drops
+    "rebuild.fetch_meta":     VerbPolicy(120.0, True, 2, 0.10, 1.00),
+    "rebuild.fetch_segments": VerbPolicy(60.0, True, 3, 0.05, 1.00),
     "sql.execute":  VerbPolicy(600.0, False),
 }
 

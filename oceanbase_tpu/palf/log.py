@@ -16,6 +16,7 @@ previous entry's term matches (truncating divergent suffixes).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import struct
 import threading
@@ -23,6 +24,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from oceanbase_tpu.native import crc64
+
+log = logging.getLogger(__name__)
 
 _HDR = struct.Struct("<QQIQ")  # term, lsn(index), payload_len, crc64
 _MAGIC = b"OBTPULG1"  # file magic + format version (bump on layout change)
@@ -58,6 +61,11 @@ class PalfReplica:
         self.voted_for: dict[int, int] = {}  # term -> candidate
         self.role = "follower"
         self._lock = threading.RLock()
+        # serializes apply callbacks WITHOUT holding self._lock: the
+        # callback reaches into engine/tx state whose own paths call
+        # back into the log (commit -> append), so running it under a
+        # log lock would order locks both ways (deadlock under churn)
+        self._apply_mutex = threading.Lock()
         self._log_f = None
         if log_dir is not None:
             os.makedirs(log_dir, exist_ok=True)
@@ -106,11 +114,18 @@ class PalfReplica:
         with open(path, "rb") as f:
             buf = f.read()
         if not buf.startswith(_MAGIC):
-            # unknown/older format: refuse to guess — treat as unreadable
-            # (peer catch-up restores state; a format migration tool would
-            # go here)
+            # unknown/older format: refuse to guess — quarantine the file
+            # so a later append cannot land BEHIND unreadable bytes that
+            # the next recovery would stop at (peer catch-up restores
+            # state; a format migration tool would go here)
+            if buf:
+                os.replace(path, path + ".corrupt")
+                log.warning("palf replica %d: quarantined %d unreadable "
+                            "log bytes to %s", self.replica_id, len(buf),
+                            path + ".corrupt")
             return
         off = len(_MAGIC)
+        valid_off = off  # end of the last fully-validated entry
         while off + _HDR.size <= len(buf):
             term, lsn, plen, crc = _HDR.unpack_from(buf, off)
             off += _HDR.size
@@ -121,6 +136,21 @@ class PalfReplica:
                 break  # corrupt tail: stop replay here (≙ checksum scan)
             self.entries.append(LogEntry(term, lsn, payload))
             off += plen
+            valid_off = off
+        if valid_off < len(buf):
+            # torn/corrupt tail bytes follow the last valid entry.  They
+            # MUST be physically truncated before any append: _persist
+            # reopens in append mode, and entries written after garbage
+            # are unreachable to the next recovery (it stops scanning at
+            # the garbage) — silently losing them.
+            with open(path, "r+b") as f:
+                f.truncate(valid_off)
+                f.flush()
+                os.fsync(f.fileno())
+            log.warning(
+                "palf replica %d: truncated %d torn/corrupt tail bytes "
+                "(log keeps %d entries)", self.replica_id,
+                len(buf) - valid_off, len(self.entries))
         if self.entries:
             self.current_term = self.entries[-1].term
 
@@ -182,19 +212,45 @@ class PalfReplica:
     # ------------------------------------------------------------------
     # commit + apply (≙ committed_end_lsn advance + apply/replay service)
     # ------------------------------------------------------------------
-    def advance_commit(self, commit_lsn: int):
+    def advance_commit(self, commit_lsn: int, drain: bool = True):
+        """Advance the commit point; ``drain=False`` defers the apply
+        callbacks to an explicit ``drain_applies()`` — for callers that
+        hold locks the callback's downstream paths also take."""
         with self._lock:
             commit_lsn = min(commit_lsn, len(self.entries))
             if commit_lsn > self.committed_lsn:
                 self.committed_lsn = commit_lsn
+        if drain:
             self._apply_committed()
 
+    def drain_applies(self):
+        self._apply_committed()
+
     def _apply_committed(self):
-        while self.applied_lsn < self.committed_lsn:
-            e = self.entries[self.applied_lsn]
-            self.applied_lsn += 1
-            if self.apply_cb is not None:
-                self.apply_cb(e)
+        """Drain committed-but-unapplied entries through the callback in
+        LSN order.  The apply mutex keeps the drain serial and ordered
+        across concurrent advance_commit callers; the replica lock is
+        NOT held across a callback (see _apply_mutex), and applied_lsn
+        only advances AFTER the callback returns, so consumers gating on
+        it (e.g. the DTL snapshot check) never run ahead of the engine.
+        A non-blocking acquire avoids deadlock when the current drainer's
+        callback is itself waiting on a lock this caller holds: the
+        active drainer re-reads the commit point each iteration, and any
+        entries it misses at the exit race drain at the next trigger."""
+        if not self._apply_mutex.acquire(blocking=False):
+            return  # an active drainer will observe the new commit point
+        try:
+            while True:
+                with self._lock:
+                    if self.applied_lsn >= self.committed_lsn:
+                        return
+                    e = self.entries[self.applied_lsn]
+                if self.apply_cb is not None:
+                    self.apply_cb(e)
+                with self._lock:
+                    self.applied_lsn += 1
+        finally:
+            self._apply_mutex.release()
 
     def close(self):
         if self._log_f:

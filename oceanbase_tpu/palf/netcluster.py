@@ -159,17 +159,24 @@ class NetPalf:
             ok = r.accept(int(prev_lsn), int(prev_term), es)
             if ok:
                 self.leader_hint = int(leader_id)
-                r.advance_commit(min(int(commit), r.last_lsn()))
-            return ok
+        if ok:
+            # apply OUTSIDE self._lock: the apply callback reaches into
+            # tx/engine state whose write paths call back into this
+            # class (commit -> append -> self._lock) from other threads —
+            # holding the palf lock across it would order the two locks
+            # both ways and deadlock under leadership churn
+            r.advance_commit(min(int(commit), r.last_lsn()))
+        return ok
 
     def _on_commit(self, commit_lsn, leader_id, term=None):
         with self._lock:
             if term is not None and int(term) < self.replica.current_term:
                 return False  # stale leader's commit point: ignore
             self.leader_hint = int(leader_id)
-            self.replica.advance_commit(
-                min(int(commit_lsn), self.replica.last_lsn()))
-            return True
+        # apply outside self._lock (same rationale as _on_accept)
+        self.replica.advance_commit(
+            min(int(commit_lsn), self.replica.last_lsn()))
+        return True
 
     def _on_state(self):
         r = self.replica
@@ -194,8 +201,15 @@ class NetPalf:
                 # Raft safety: commit prior-term entries via a no-op in
                 # the new term
                 self._replicate([b'{"op": "noop"}'])
-                return self.node_id
-            raise NoQuorum(f"node {self.node_id} lost the election")
+                won = True
+            else:
+                won = False
+        if won:
+            # catch-up residue from follower days applies OUTSIDE the
+            # palf lock (see _on_accept)
+            self.replica.drain_applies()
+            return self.node_id
+        raise NoQuorum(f"node {self.node_id} lost the election")
 
     def on_peer_down(self, peer_id: int, attempts: int = 8) -> bool:
         """Failure-detector hook: the cluster health monitor declared
@@ -244,12 +258,14 @@ class NetPalf:
     def append(self, payloads: list[bytes]) -> int:
         with self._lock:
             self.ensure_leader()
-            return self._replicate(payloads)
+            out = self._replicate(payloads)
+        # deferred applies (drain=False in _replicate) run lock-free
+        self.replica.drain_applies()
+        return out
 
     def _replicate(self, payloads: list[bytes]) -> int:
         r = self.replica
         entries = r.leader_append(payloads)
-        self.local_lsns.update(e.lsn for e in entries)
         commit_target = entries[-1].lsn if entries else r.last_lsn()
         acks = 1
         for pid in sorted(self.peers):
@@ -259,7 +275,16 @@ class NetPalf:
         if acks < quorum:
             raise NoQuorum(
                 f"append replicated to {acks}/{len(self.peers) + 1}")
-        r.advance_commit(commit_target)
+        # mark leader-originated lsns only AFTER quorum: committed
+        # entries are never replaced (Raft), so the skip in
+        # _apply_entry is safe — whereas marking a NoQuorum'd batch
+        # would make this node skip-apply whatever a later leader
+        # commits at those lsns (its replacement entries, or even our
+        # own, whose effects the failed write path never applied)
+        self.local_lsns.update(e.lsn for e in entries)
+        # caller holds self._lock: defer apply callbacks to the
+        # drain_applies() after the lock releases (append/elect)
+        r.advance_commit(commit_target, drain=False)
         self.proposer.refresh_lease()
         for pid, cli in self.peers.items():
             try:

@@ -151,7 +151,19 @@ DEF("enable_zone_map_pruning", True, "bool",
 DEF("wal_replica_count", 3, "int", "PALF replica count", _pos)
 DEF("palf_lease_ms", 400, "int", "election lease duration", _pos)
 DEF("log_checkpoint_interval_s", 60, "int",
-    "checkpoint cadence bounding WAL replay length", _pos)
+    "periodic checkpoint cadence advancing the WAL replay point so "
+    "restart replay cost is O(tail), not O(history)", _pos)
+DEF("checkpoint_lag_entries", 256, "int",
+    "minimum applied WAL entries past the persisted replay point "
+    "before a periodic checkpoint bothers flushing", _nonneg)
+
+# crash recovery / rebuild (net/rebuild.py, storage/recovery.py)
+DEF("enable_auto_rebuild", True, "bool",
+    "a node booting with NO local recovery sources (no manifest, slog "
+    "or WAL) bootstraps from a peer's checkpoint + segments + WAL via "
+    "the rebuild.fetch_* verbs (≙ replica rebuild ha_dag)")
+DEF("rebuild_chunk_bytes", 4 << 20, "cap",
+    "byte budget per rebuild.fetch_segments chunk", _pos)
 
 # tenants / resources
 DEF("tenant_cpu_quota", 4, "int", "worker threads per tenant unit", _pos)

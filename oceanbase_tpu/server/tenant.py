@@ -26,12 +26,25 @@ from oceanbase_tpu.tx.service import TransService
 
 class Tenant:
     def __init__(self, name: str, root: str | None, cluster_config: Config,
-                 wal_replicas: int = 3, wal=None):
+                 wal_replicas: int = 3, wal=None, recovery=None):
         """``wal``: inject an external log handle (a NetPalf group whose
         replicas live in other OS processes, palf/netcluster.py) instead
-        of the in-process PalfCluster — the multi-node path."""
+        of the in-process PalfCluster — the multi-node path.
+        ``recovery``: a shared RecoveryState (the node process passes its
+        own so rebuild + boot events land in one gv$recovery log)."""
+        import time as _time
+
+        from oceanbase_tpu.server import trace as qtrace
+        from oceanbase_tpu.storage.recovery import RecoveryState
+
         self.name = name
         self.config = Config(parent=cluster_config)
+        self.recovery = recovery if recovery is not None \
+            else RecoveryState()
+        # serializes checkpoint() across its three callers (the node's
+        # periodic loop, rebuild.fetch_meta handlers, admin sessions):
+        # interleaved checkpoints could persist a REGRESSED replay point
+        self._ckpt_lock = threading.Lock()
         data_dir = os.path.join(root, "data") if root else None
         wal_dir = os.path.join(root, "wal") if root else None
         if wal_dir:
@@ -47,11 +60,45 @@ class Tenant:
         self.tx = TransService(wal=self.wal)
         self.tx.engine = self.engine  # secondary-index maintenance
 
+        # restart tier: replay the palf WAL tail from the persisted
+        # replay point (the periodic checkpoint keeps it O(tail), not
+        # O(history)) through the service's PERSISTENT replay buffers,
+        # so a commit record arriving later via catch-up still finds
+        # redo the boot replay buffered
         start = self.engine.meta.get("wal_lsn", 0)
+        m0 = _time.monotonic()
+        stats: dict = {}
         if local.committed_lsn > start:
-            max_ts = TransService.replay(
-                local.entries[start:local.committed_lsn], self.engine)
+            with qtrace.span("recovery.replay", tenant=name,
+                             start_lsn=start, end_lsn=local.committed_lsn):
+                max_ts = self.tx.apply_replay(
+                    local.entries[start:local.committed_lsn], stats=stats)
             self.tx.gts.advance_to(max_ts)
+        if stats.get("entries") or start or local.last_lsn():
+            # a networked replica restores its log but cannot know the
+            # commit point without quorum: its apply happens through
+            # catch-up (leader push / election noop) from ``start``
+            deferred = local.last_lsn() - max(local.committed_lsn, start)
+            self.recovery.record(
+                "boot_replay", tenant=name, wal_start_lsn=start,
+                wal_end_lsn=local.committed_lsn,
+                entries=stats.get("entries", 0),
+                prepared=stats.get("prepared", 0),
+                elapsed_s=_time.monotonic() - m0,
+                note=f"commits={stats.get('commits', 0)}"
+                     + (f" deferred_to_catchup={deferred}"
+                        if deferred > 0 else ""))
+        # durable XA: branches prepared before the crash reconstruct
+        # into PREPARE state (XA RECOVER reports them; XA COMMIT applies
+        # their WAL-buffered redo) — closes the round-5 LIMITATION
+        with qtrace.span("recovery.restore_prepared", tenant=name) as sp:
+            restored = self.tx.restore_prepared()
+            sp.tags["branches"] = len(restored)
+        if restored:
+            self.recovery.record(
+                "restore_prepared", tenant=name, prepared=len(restored),
+                xids=",".join(sorted(tx.xid for tx in restored
+                                     if tx.xid)))
         # incremental apply (multi-node) resumes where boot replay ended:
         # entries at/below the checkpoint replay-point are already in the
         # engine (segments/slog), later committed ones were just replayed
@@ -122,20 +169,52 @@ class Tenant:
         return self._pool.submit(fn, *args, **kwargs)
 
     def checkpoint(self):
+        with self._ckpt_lock:
+            self._checkpoint_locked()
+
+    def _checkpoint_locked(self):
+        import time as _time
+
+        from oceanbase_tpu.server import trace as qtrace
+
         # capture the replay point BEFORE the flush snapshot: commit()
         # assigns the version before appending to the WAL, so every
         # commit at or below this LSN has version <= snap and is covered
         # by the flushed segments (a commit landing between the two reads
         # has LSN > wal_lsn and is replayed on recovery)
-        wal_lsn = self.wal.committed_lsn()
-        snap = self.tx.gts.current()
-        for name in list(self.engine.tables):
-            self.engine.freeze_and_flush(name, snapshot=snap)
-        # group commit means live transactions have nothing in the WAL, so
-        # the committed LSN is always a safe replay point
-        self.engine.meta["wal_lsn"] = wal_lsn
-        self.engine.meta["gts"] = self.tx.gts.current()
-        self.engine.checkpoint()
+        m0 = _time.monotonic()
+        # the flush horizon clamps BOTH halves to the oldest active
+        # transaction: versions a live writer's conflict check still
+        # needs stay in the memtables, and the replay point only covers
+        # commits the clamped flush snapshot captured
+        snap, wal_lsn = self.tx.flush_horizon()
+        # a follower may have committed-but-not-yet-applied entries:
+        # those are not in its memtables, so the flush below would not
+        # cover them — the replay point must not skip them
+        local = getattr(self.wal, "replica", None)
+        if local is not None:
+            wal_lsn = min(wal_lsn, local.applied_lsn)
+        # group commit keeps ordinary live transactions out of the WAL,
+        # but a prepared XA branch's redo lives ONLY there until its
+        # commit/abort — never advance past its prepare batch
+        clamp = self.tx.min_prepared_lsn()
+        if clamp is not None:
+            wal_lsn = min(wal_lsn, clamp)
+        # monotonic: a long-lived tx can clamp this checkpoint's horizon
+        # BELOW a previous one; commits under the old replay point are
+        # already durable in segments, so never regress it
+        wal_lsn = max(wal_lsn, int(self.engine.meta.get("wal_lsn", 0)))
+        with qtrace.span("recovery.checkpoint", tenant=self.name,
+                         wal_lsn=wal_lsn):
+            for name in list(self.engine.tables):
+                self.engine.freeze_and_flush(name, snapshot=snap)
+            self.engine.meta["wal_lsn"] = wal_lsn
+            self.engine.meta["gts"] = self.tx.gts.current()
+            self.engine.checkpoint()
+        self.recovery.record(
+            "checkpoint", tenant=self.name, wal_end_lsn=wal_lsn,
+            elapsed_s=_time.monotonic() - m0,
+            note=f"clamped={clamp is not None}")
 
     def close(self):
         self._pool.shutdown(wait=False)

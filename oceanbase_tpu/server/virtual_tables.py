@@ -34,6 +34,7 @@ class VirtualTables:
             "gv$plan_cache": self.plan_cache,
             "gv$px_exchange": self.px_exchange,
             "gv$cluster_health": self.cluster_health,
+            "gv$recovery": self.recovery,
             "gv$trace": self.trace,
             "gv$active_session_history": self.active_session_history,
             "gv$system_event": self.wait_events,
@@ -195,6 +196,58 @@ class VirtualTables:
             "retries": np.array([r["retries"] for r in rows], np.int64),
             "deadline_exceeded": np.array(
                 [r["deadline_exceeded"] for r in rows], np.int64),
+            "last_transition_ts": np.array(
+                [r.get("last_transition_ts", 0.0) for r in rows],
+                np.float64),
+        }
+
+    def recovery(self):
+        """Crash-recovery progress (storage/recovery.py): one row per
+        boot_replay / restore_prepared / rebuild / checkpoint event,
+        plus a live 'catchup' row (local WAL apply point vs the group
+        commit point) and the prepared XA branches still recoverable —
+        ≙ __all_virtual_ls_restore_progress + DBA_OB_XA_TRANSACTIONS."""
+        rows = []
+        for name, t in sorted(self.db.tenants.items()):
+            rec = getattr(t, "recovery", None)
+            if rec is not None:
+                rows.extend(rec.rows())
+            xids = t.tx.recoverable_xids()
+            if xids:
+                rows.append({"ts": time.time(), "tenant": name,
+                             "phase": "prepared_xa",
+                             "prepared": len(xids),
+                             "xids": ",".join(xids)})
+        node = getattr(self.db, "_node", None)
+        if node is not None:
+            r = node.palf.replica
+            rows.append({
+                "ts": time.time(), "tenant": "sys", "phase": "catchup",
+                "wal_start_lsn": r.applied_lsn,
+                "wal_end_lsn": r.committed_lsn,
+                "entries": max(r.committed_lsn - r.applied_lsn, 0),
+                "note": f"replay_point="
+                        f"{node.engine.meta.get('wal_lsn', 0)}"})
+        return {
+            "ts": np.array([r.get("ts", 0.0) for r in rows], np.float64),
+            "tenant": _obj(r.get("tenant", "sys") for r in rows),
+            "phase": _obj(r.get("phase", "") for r in rows),
+            "peer": np.array([r.get("peer", -1) for r in rows],
+                             np.int64),
+            "wal_start_lsn": np.array(
+                [r.get("wal_start_lsn", 0) for r in rows], np.int64),
+            "wal_end_lsn": np.array(
+                [r.get("wal_end_lsn", 0) for r in rows], np.int64),
+            "entries": np.array([r.get("entries", 0) for r in rows],
+                                np.int64),
+            "bytes": np.array([r.get("bytes", 0) for r in rows],
+                              np.int64),
+            "prepared": np.array([r.get("prepared", 0) for r in rows],
+                                 np.int64),
+            "xids": _obj(r.get("xids", "") for r in rows),
+            "elapsed_s": np.array(
+                [r.get("elapsed_s", 0.0) for r in rows], np.float64),
+            "note": _obj(r.get("note", "") for r in rows),
         }
 
     def session_history(self):

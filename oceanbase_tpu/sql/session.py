@@ -511,7 +511,10 @@ class Session:
         if self.db is None:
             raise ValueError("ALTER SYSTEM needs a Database")
         eng = self._engine
-        snap = self._txsvc.gts.current()
+        # flush at the horizon, not gts-now: versions newer than a live
+        # transaction's snapshot must stay in the memtables or its
+        # write-conflict check goes blind (lost update)
+        snap = self._txsvc.flush_snapshot()
         for name in list(eng.tables):
             eng.freeze_and_flush(name, snapshot=snap)
             if stmt.action == "major_freeze":
@@ -733,8 +736,9 @@ class Session:
             return
         limit = int(self.tenant.config["memstore_limit_rows"])
         if len(ts.tablet.active) >= limit:
+            # horizon-clamped: see _alter_system major_freeze
             self._engine.freeze_and_flush(
-                table, snapshot=self._txsvc.gts.current())
+                table, snapshot=self._txsvc.flush_snapshot())
             self.catalog.invalidate(table)
             l0 = sum(1 for s in ts.tablet.segments if s.level == 0)
             if l0 >= int(self.tenant.config["minor_compact_trigger"]):
@@ -1791,10 +1795,7 @@ class Session:
         # the store lives on the TENANT's TransService: xids, tx ids,
         # WALs, and lock tables are all tenant-scoped — a db-global
         # store would let another tenant's service commit this tx
-        svc = self._txsvc
-        if not hasattr(svc, "xa_transactions"):
-            svc.xa_transactions = {}
-        return svc.xa_transactions
+        return self._txsvc.xa_transactions
 
     def _xa(self, stmt: ast.XaStmt) -> Result:
         store = self._xa_store()
@@ -1808,10 +1809,9 @@ class Session:
             store[stmt.xid] = self._tx
             return _ok()
         if stmt.op == "recover":
-            from oceanbase_tpu.tx.service import TxState
-
-            xids = sorted(x for x, tx in store.items()
-                          if tx.state == TxState.PREPARE)
+            # the service's locked view (live-prepared AND crash-
+            # recovered branches — durable XA)
+            xids = self._txsvc.recoverable_xids()
             return Result(["xid"],
                           {"xid": np.array(xids, dtype=object)}, {},
                           {"xid": SqlType.string()}, rowcount=len(xids))

@@ -21,7 +21,6 @@ Model:
 
 from __future__ import annotations
 
-import itertools
 import json
 import threading
 from dataclasses import dataclass, field
@@ -59,6 +58,22 @@ class Transaction:
     state: TxState = TxState.ACTIVE
     participants: dict = field(default_factory=dict)  # table -> Participant
     stmt_seq: int = 0  # statement counter (savepoint granularity)
+    # XA: external branch id (set by the session on XA START) and, after
+    # XA PREPARE, the WAL replay point that must stay BELOW any
+    # checkpoint while this branch is pending (its redo lives only in
+    # the WAL until commit)
+    xid: str | None = None
+    prepare_lsn: int = -1  # -1: no WAL presence to protect
+    # crash recovery: marks a branch reconstructed from replayed
+    # prepare records (sync_recovered re-creates its uncommitted
+    # tablet versions, so commit/rollback take the ordinary paths)
+    recovered: bool = False
+    # WAL commit point when this tx began: commits at/below it are
+    # strictly older than this tx's snapshot (commit serializes under
+    # the service lock), so a checkpoint replay point clamped to the
+    # oldest live begin_lsn only covers commits its clamped flush
+    # snapshot captured
+    begin_lsn: int = 0
     # group-commit buffer: redo lives here (and in the memtable) until the
     # commit ships everything in one replicated append.  Unbounded for
     # huge transactions — incremental pre-commit flush is an r2 item.
@@ -95,16 +110,62 @@ class TransService:
         from oceanbase_tpu.storage.indexes import IndexKeyLocks
 
         self.index_locks = IndexKeyLocks()
-        self._next_tx = itertools.count(1)
+        self._next_tx_id = 0
         self._live: dict[int, Transaction] = {}
         self._lock = threading.RLock()
+        # XA branch registry: xid -> Transaction (live-prepared or
+        # crash-recovered); the session's XA verbs drive it
+        self.xa_transactions: dict[str, Transaction] = {}
+        # WAL replay state, shared between boot replay and incremental
+        # follower apply so a commit record arriving AFTER a restart
+        # still finds the redo the boot replay buffered:
+        #   replay_pending:  tx -> [redo records] not yet committed
+        #   replay_prepared: tx -> {xid, version, lsn, tables} of
+        #                    prepare records with no commit/abort yet
+        self.replay_pending: dict[int, list] = {}
+        self.replay_prepared: dict[int, dict] = {}
 
     # ------------------------------------------------------------------
+    def advance_tx_id(self, past: int):
+        """Never-go-back seeding on recovery: replayed transactions keep
+        their ids; new ones must not collide with a reconstructed
+        prepared branch's uncommitted id space."""
+        with self._lock:
+            self._next_tx_id = max(self._next_tx_id, int(past))
+
     def begin(self) -> Transaction:
         with self._lock:
-            tx = Transaction(next(self._next_tx), self.gts.get_ts())
+            self._next_tx_id += 1
+            tx = Transaction(self._next_tx_id, self.gts.get_ts())
+            if self.wal is not None:
+                tx.begin_lsn = self.wal.committed_lsn()
             self._live[tx.tx_id] = tx
             return tx
+
+    def flush_horizon(self):
+        """-> (snapshot, wal_lsn) safe for a memtable flush/checkpoint,
+        clamped to the oldest ACTIVE transaction.
+
+        First-committer-wins reads version CHAINS: a version committed
+        after a live writer's snapshot must stay in the memtables
+        (mini_compact carries post-snapshot versions back into the
+        active memtable) or the conflict becomes invisible once flushed
+        into a segment — a lost update.  The wal_lsn half keeps the
+        checkpoint replay point consistent with the clamped snapshot:
+        commits at/below the oldest live begin_lsn are strictly older
+        than every live snapshot, hence covered by the flush."""
+        with self._lock:
+            active = [t for t in self._live.values()
+                      if t.state == TxState.ACTIVE]
+            snap = min([self.gts.current()]
+                       + [t.snapshot for t in active])
+            lsn = 0 if self.wal is None else \
+                min([self.wal.committed_lsn()]
+                    + [t.begin_lsn for t in active])
+            return snap, lsn
+
+    def flush_snapshot(self) -> int:
+        return self.flush_horizon()[0]
 
     def write(self, tx: Transaction, table: str, tablet, key: tuple,
               op: str, values: dict):
@@ -217,12 +278,13 @@ class TransService:
         """Phase 1: make the tx's redo + prepare records durable; the tx
         stays in PREPARE until an explicit XA COMMIT/ROLLBACK.
 
-        LIMITATION (round 5): the PREPARE state itself is process-local —
-        replay does not yet reconstruct prepared txs after a restart, so
-        a crash between PREPARE and COMMIT implicitly rolls the branch
-        back (its redo is buffered but never applied without a commit
-        record).  The reference recovers into prepared state
-        (ob_xa_service.h); the WAL already carries the records needed."""
+        Durability: the prepare records carry the branch xid, so a crash
+        between PREPARE and COMMIT reconstructs the branch at replay
+        (``restore_prepared``) instead of implicitly rolling it back —
+        ≙ ObXAService recovering into prepared state
+        (src/storage/tx/ob_xa_service.h).  ``tx.prepare_lsn`` records
+        the WAL replay point that checkpoints must not advance past
+        while the branch is pending (its redo exists ONLY in the WAL)."""
         with self._lock:
             if tx.state != TxState.ACTIVE:
                 raise TxAborted(f"tx {tx.tx_id} is {tx.state.value}")
@@ -231,28 +293,44 @@ class TransService:
                 p.state = TxState.PREPARE
                 p.prepare_version = self.gts.get_ts()
                 records.append({"op": "prepare", "tx": tx.tx_id,
-                                "table": p.table,
+                                "table": p.table, "xid": tx.xid,
                                 "version": p.prepare_version})
-            self._log_batch(records)
+            end_lsn = self._log_batch(records)
+            # the batch occupies [end-len+1, end]: a checkpoint replay
+            # point at end-len still replays every record of the batch
+            # (an empty or WAL-less branch has nothing to protect)
+            if records and end_lsn:
+                tx.prepare_lsn = max(end_lsn - len(records), 0)
             tx.pending_redo = []
             tx.state = TxState.PREPARE
+            if tx.xid is not None:
+                self.xa_transactions[tx.xid] = tx
 
     def xa_commit_prepared(self, tx: Transaction) -> int:
-        """Phase 2 commit of a PREPARED tx (any session may drive it)."""
+        """Phase 2 commit of a PREPARED tx (any session may drive it) —
+        crash-recovered branches included (sync_recovered restored
+        their uncommitted tablet versions, so this is one code path)."""
         with self._lock:
             if tx.state != TxState.PREPARE:
                 raise TxAborted(
                     f"tx {tx.tx_id} is {tx.state.value}, not prepared")
+            # a crash-recovered branch took the live shape at
+            # sync_recovered (uncommitted tablet versions + participants),
+            # so one path commits both — and the commit version is the
+            # negotiated prepare version either way, keeping the WAL
+            # record identical to what followers will stamp
             parts = list(tx.participants.values())
             version = max((p.prepare_version for p in parts),
                           default=self.gts.get_ts())
             self._log({"op": "commit", "tx": tx.tx_id,
                        "version": version})
             for p in parts:
-                p.tablet.commit(tx.tx_id, version, p.keys)
+                if p.tablet is not None:
+                    p.tablet.commit(tx.tx_id, version, p.keys)
                 p.state = TxState.COMMIT
+            self.gts.advance_to(version)
             tx.state = TxState.CLEAR
-            self._live.pop(tx.tx_id, None)
+            self._forget_xa_locked(tx)
             self._release_locks(tx)
             return version
 
@@ -264,10 +342,42 @@ class TransService:
             # replay drops the buffered records
             self._log({"op": "abort", "tx": tx.tx_id})
             for p in tx.participants.values():
-                p.tablet.abort(tx.tx_id, p.keys)
+                if p.tablet is not None:
+                    p.tablet.abort(tx.tx_id, p.keys)
             tx.state = TxState.ABORT
-            self._live.pop(tx.tx_id, None)
+            self._forget_xa_locked(tx)
             self._release_locks(tx)
+
+    def _forget_xa_locked(self, tx: Transaction):
+        """Drop every trace of a terminated XA branch: the live map, the
+        xid registry, and the replay buffers (so an ended branch stops
+        clamping checkpoints and cannot be re-registered by sync)."""
+        self._live.pop(tx.tx_id, None)
+        if tx.xid is not None:
+            cur = self.xa_transactions.get(tx.xid)
+            if cur is tx:
+                self.xa_transactions.pop(tx.xid, None)
+        self.replay_pending.pop(tx.tx_id, None)
+        self.replay_prepared.pop(tx.tx_id, None)
+
+    def recoverable_xids(self) -> list[str]:
+        """XA RECOVER's data: xids of branches in PREPARE state (live or
+        crash-reconstructed) this service can still commit or roll back."""
+        with self._lock:
+            return sorted(x for x, tx in self.xa_transactions.items()
+                          if tx.state == TxState.PREPARE)
+
+    def min_prepared_lsn(self):
+        """Smallest WAL replay point still needed by a pending prepared
+        branch (live or recovered), or None.  Checkpoints clamp their
+        replay point to it: a prepared branch's redo lives ONLY in the
+        WAL, so advancing past its prepare batch would lose the branch
+        at the next restart."""
+        with self._lock:
+            lsns = [tx.prepare_lsn for tx in self._live.values()
+                    if tx.state == TxState.PREPARE
+                    and tx.xid is not None and tx.prepare_lsn >= 0]
+            return min(lsns) if lsns else None
 
     def rollback(self, tx: Transaction):
         with self._lock:
@@ -308,21 +418,130 @@ class TransService:
     # ------------------------------------------------------------------
     # recovery (≙ replayservice applying committed log to memtables)
     # ------------------------------------------------------------------
+    def apply_replay(self, entries, stats: dict | None = None) -> int:
+        """Instance replay against this service's persistent replay
+        buffers: boot replay and incremental follower apply share ONE
+        pending/prepared state, so a commit record that arrives through
+        catch-up AFTER a restart still finds the redo the boot replay
+        buffered.  Keeps the xid registry in sync (prepared branches
+        appear in XA RECOVER as soon as their prepare record applies;
+        terminated ones disappear) and returns the max commit ts seen."""
+        if stats is None:
+            stats = {}
+        max_ts = self.replay(entries, self.engine,
+                             pending=self.replay_pending,
+                             prepared=self.replay_prepared, stats=stats)
+        self.sync_recovered()
+        # seed the tx-id allocator past every replayed id: a follower
+        # promoted to leader must not mint ids that collide with a
+        # replayed (possibly still-prepared) transaction's id space
+        self.advance_tx_id(stats.get("max_tx", 0))
+        return max_ts
+
+    def restore_prepared(self) -> list:
+        """Boot-time hook (after the WAL tail replays): reconstruct every
+        XA branch whose prepare records survived with no commit/abort —
+        ≙ ObXAService crash recovery into prepared state.  Returns ALL
+        currently-recovered branches (incremental replay may have
+        registered them already), also reachable via XA RECOVER."""
+        self.sync_recovered()
+        with self._lock:
+            return [tx for tx in self._live.values()
+                    if tx.recovered and tx.state == TxState.PREPARE]
+
+    def sync_recovered(self) -> list:
+        """Reconcile the xid registry with the replay buffers: register
+        newly-replayed prepared branches, drop branches a replayed
+        commit/abort record terminated.
+
+        A reconstructed branch takes the LIVE prepared shape: its redo
+        is re-written into the tablets as UNCOMMITTED versions, so
+        first-committer-wins checks see the branch exactly like before
+        the crash (a concurrent write to its keys conflicts instead of
+        silently racing the pending XA COMMIT), and the commit/rollback
+        paths are the ordinary participant paths.  (Unique-index ROWKEY
+        locks are not reacquired — narrower than the reference's
+        recovered lock tables.)"""
+        restored = []
+        with self._lock:
+            for tx_id, info in sorted(self.replay_prepared.items()):
+                xid = info.get("xid")
+                if xid is None or tx_id in self._live:
+                    continue  # pre-durable-XA record or already known
+                redo = list(self.replay_pending.get(tx_id, []))
+                version = int(info.get("version", 0))
+                tx = Transaction(tx_id, snapshot=version)
+                tx.state = TxState.PREPARE
+                tx.xid = xid
+                tx.recovered = True
+                # the replay point that still covers the whole batch is
+                # one below its first record
+                first = min([int(info.get("lsn", 1))]
+                            + [int(r.get("_lsn", 1)) for r in redo])
+                tx.prepare_lsn = max(first - 1, 0)
+                for r in redo:
+                    ts = (self.engine.tables.get(r["table"])
+                          if self.engine is not None else None)
+                    p = tx.participant(
+                        r["table"], ts.tablet if ts is not None else None)
+                    key = tuple(r["key"])
+                    p.keys.append(key)
+                    p.state = TxState.PREPARE
+                    p.prepare_version = version
+                    if ts is not None:
+                        # no snapshot arg: recovery reapply, the check
+                        # that would conflict is the one being restored
+                        ts.tablet.write(key, r["kind"], r["values"],
+                                        tx_id)
+                self._live[tx_id] = tx
+                self.xa_transactions[xid] = tx
+                self.advance_tx_id(tx_id)
+                self.gts.advance_to(version)
+                restored.append(tx)
+            # a commit/abort record replayed for a branch we had
+            # reconstructed: replay already applied (or dropped) its
+            # redo — retire the placeholder.  After a replayed COMMIT
+            # the reconstructed versions were stamped alongside the
+            # pending redo (same tx id), so the abort below is a no-op;
+            # after a replayed ABORT it removes them.
+            for tx_id in [t for t, tx in self._live.items()
+                          if tx.recovered
+                          and t not in self.replay_prepared]:
+                tx = self._live.pop(tx_id)
+                for p in tx.participants.values():
+                    if p.tablet is not None:
+                        p.tablet.abort(tx_id, p.keys)
+                if tx.xid is not None and \
+                        self.xa_transactions.get(tx.xid) is tx:
+                    self.xa_transactions.pop(tx.xid, None)
+        return restored
+
     @staticmethod
-    def replay(entries, engine, pending: dict | None = None):
+    def replay(entries, engine, pending: dict | None = None,
+               prepared: dict | None = None, stats: dict | None = None):
         """Replay committed WAL records into a StorageEngine's memtables.
         Redo is buffered per tx and applied at its commit record, matching
         commit-version visibility.  ``pending`` carries the redo buffer
         across incremental calls (follower apply streams one entry at a
-        time, ≙ replayservice applying as committed_lsn advances)."""
+        time, ≙ replayservice applying as committed_lsn advances);
+        ``prepared`` (optional) collects prepare records not yet
+        terminated by a commit/abort — the durable-XA reconstruction
+        input; ``stats`` (optional) accumulates replay progress counters
+        for gv$recovery."""
         if pending is None:
             pending = {}
+        if stats is None:
+            stats = {}
         max_ts = 0
         for e in entries:
+            stats["entries"] = stats.get("entries", 0) + 1
             try:
                 rec = json.loads(e.payload.decode())
             except Exception:
                 continue
+            tx_id = rec.get("tx")
+            if tx_id is not None:
+                stats["max_tx"] = max(stats.get("max_tx", 0), tx_id)
             op = rec.get("op")
             if op == "ddl":
                 # replicated logical DDL (multi-node log stream).  Apply
@@ -331,10 +550,24 @@ class TransService:
                 # first, then the WAL suffix).
                 _replay_ddl(rec["slog"], engine)
             elif op == "redo":
+                rec["_lsn"] = e.lsn  # prepared-branch replay-point bound
                 pending.setdefault(rec["tx"], []).append(rec)
+            elif op == "prepare":
+                # XA phase 1 (durable): remember the branch until a
+                # commit/abort terminates it; leftovers at the end of
+                # replay are crash-recoverable prepared branches
+                if prepared is not None:
+                    info = prepared.setdefault(rec["tx"], {})
+                    if rec.get("xid") is not None:
+                        info["xid"] = rec["xid"]
+                    info["version"] = max(int(info.get("version", 0)),
+                                          int(rec.get("version", 0)))
+                    info["lsn"] = min(int(info.get("lsn", e.lsn)), e.lsn)
+                    stats["prepared"] = stats.get("prepared", 0) + 1
             elif op == "commit":
                 version = rec["version"]
                 max_ts = max(max_ts, version)
+                stats["commits"] = stats.get("commits", 0) + 1
                 for r in pending.pop(rec["tx"], []):
                     ts = engine.tables.get(r["table"])
                     if ts is None:
@@ -342,10 +575,13 @@ class TransService:
                     key = tuple(r["key"])
                     ts.tablet.write(key, r["kind"], r["values"], rec["tx"])
                     ts.tablet.commit(rec["tx"], version, [key])
+                if prepared is not None:
+                    prepared.pop(rec["tx"], None)
             elif op == "abort":
-                # only pre-group-commit WALs contain abort records; kept
-                # for replaying logs written by older versions
+                # XA phase-1 rollback (and pre-group-commit WALs)
                 pending.pop(rec["tx"], None)
+                if prepared is not None:
+                    prepared.pop(rec["tx"], None)
             elif op == "truncate":
                 # replayed in log order: discard everything replayed into
                 # the table so far (≙ TRUNCATE barrier in the redo stream).

@@ -13,7 +13,19 @@ Q1 answers, then replays the queries under three nemesis scenarios:
               slices away and, if leadership moves, statement routing
               follows it;
   nodekill    SIGKILL a data node while a query is in flight — the
-              in-flight slice falls back to the coordinator's replica.
+              in-flight slice falls back to the coordinator's replica;
+
+then two recovery scenarios close the loop (PR 6):
+
+  nodekill_restart   restart the SIGKILLed process: WAL replay + leader
+                     catch-up + rejoin; the detector flips back to up,
+                     DTL sends slices to it again (avoided_parts → 0),
+                     a row committed right before the kill reads from
+                     the restarted node, and an XA branch prepared
+                     before the kill is recoverable and commits;
+  wipe_rebuild       empty the node's data dir: it bootstraps from a
+                     peer checkpoint + segments + WAL over the chunked
+                     rebuild.fetch_* verbs and reaches parity.
 
 Every query must return BIT-IDENTICAL rows to the fault-free baseline
 and finish inside the bench deadline (no query may ride a hung socket).
@@ -67,25 +79,39 @@ def boot_cluster(root, n=3, seed=7):
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = {}
-    for i in range(1, n + 1):
-        node_root = os.path.join(root, f"n{i}")
-        os.makedirs(node_root, exist_ok=True)
+
+    def write_config(i):
         # arm the admin verb + pin the nemesis seed BEFORE boot (config
         # is per-node; ALTER SYSTEM on a follower would route to the
         # leader instead of the node under test)
+        node_root = os.path.join(root, f"n{i}")
+        os.makedirs(node_root, exist_ok=True)
         with open(os.path.join(node_root, "config.json"), "w") as f:
+            # dtl_min_rows is seeded on EVERY node (not just via ALTER
+            # SYSTEM on the current leader): statement routing follows
+            # leadership if it moves mid-nemesis, and the new leader
+            # must keep pushing down for gv$px_exchange assertions
             json.dump({"enable_fault_injection": True,
-                       "fault_seed": seed}, f)
+                       "fault_seed": seed,
+                       "dtl_min_rows": 1}, f)
+        return node_root
+
+    def start_node(i, bootstrap=False):
+        node_root = os.path.join(root, f"n{i}")
         peers = ",".join(f"{j}=127.0.0.1:{ports[j - 1]}"
                          for j in range(1, n + 1) if j != i)
         cmd = [sys.executable, "-m", "oceanbase_tpu.net.node",
                "--node-id", str(i), "--port", str(ports[i - 1]),
                "--peers", peers, "--root", node_root]
-        if i == 1:
+        if bootstrap:
             cmd.append("--bootstrap")
         procs[i] = subprocess.Popen(cmd, env=env,
                                     stdout=subprocess.DEVNULL,
                                     stderr=subprocess.DEVNULL)
+
+    for i in range(1, n + 1):
+        write_config(i)
+        start_node(i, bootstrap=(i == 1))
     clients = {i: RpcClient("127.0.0.1", ports[i - 1], timeout_s=60.0)
                for i in range(1, n + 1)}
     deadline = time.time() + 60
@@ -96,7 +122,7 @@ def boot_cluster(root, n=3, seed=7):
             time.sleep(0.2)
         else:
             raise TimeoutError(f"node {i} not ready")
-    return procs, clients
+    return procs, clients, start_node, write_config
 
 
 def rows_of(res):
@@ -192,7 +218,8 @@ def main():
     out = {"metric": "chaos_bench", "rows": n_rows, "seed": seed,
            "query_deadline_s": QUERY_DEADLINE_S, "scenarios": {}}
     try:
-        procs, clients = boot_cluster(root, seed=seed)
+        procs, clients, start_node, write_config = \
+            boot_cluster(root, seed=seed)
         c1 = clients[1]
 
         def sql(text, node=1):
@@ -294,6 +321,20 @@ def main():
             "leader_view": {r["peer"]: r["state"]
                             for r in hp["peers"]}}
 
+        # staged BEFORE the SIGKILL: a committed marker row plus a
+        # prepared-but-uncommitted XA branch (both with l_shipdate
+        # outside the q1/q6 windows so the parity baselines hold) —
+        # the restart scenario must find the marker on the restarted
+        # node and the branch recoverable (durable XA)
+        marker_k, xa_k = n_rows + 1, n_rows + 2
+        sql(f"insert into lineitem values ({marker_k}, 1, 1, 1,"
+            f" 10200, 0, 0)")
+        sql("xa start 'cb1'")
+        sql(f"insert into lineitem values ({xa_k}, 1, 1, 1,"
+            f" 10200, 0, 0)")
+        sql("xa end 'cb1'")
+        sql("xa prepare 'cb1'")
+
         # ---- scenario 3: kill a data node mid-query ----------------
         results = {}
 
@@ -325,6 +366,93 @@ def main():
         av, fb = rows_of(ex)[0]
         out["avoided_parts_last"] = int(av)
         out["fallback_parts_last"] = int(fb)
+
+        # ---- scenario 4: restart the SIGKILLed node ----------------
+        # restart replay + log catch-up + rejoin: the detector flips
+        # down→up, DTL routes slices back (avoided_parts returns to 0),
+        # the pre-kill marker row reads from the restarted node, and
+        # the pre-kill prepared XA branch commits (durable XA)
+        t0 = time.monotonic()
+        start_node(3)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if clients[3].ping():
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("restarted node 3 never came up")
+        if not wait_detector(c1, 3, ("up",), timeout=30):
+            raise TimeoutError("detector never flipped node 3 up")
+        # only the marker is committed (the XA branch is prepared, its
+        # redo invisible until commit)
+        wait_converged(clients, "lineitem", n_rows + 1)
+        restart_s = time.monotonic() - t0
+
+        def weak3(q):
+            return clients[3].call("sql.execute", sql=q,
+                                   consistency="weak")
+
+        m = rows_of(weak3(
+            f"select l_quantity from lineitem where l_id = {marker_k}"))
+        marker_ok = m == [(1,)]
+        rec = clients[3].call("recovery.state")
+        xa_recoverable = "cb1" in rec.get("prepared_xids", [])
+        sql("xa commit 'cb1'")
+        wait_converged(clients, "lineitem", n_rows + 2)
+        xa_row = rows_of(weak3(
+            f"select l_quantity from lineitem where l_id = {xa_k}"))
+        parity, lat, hung = run_queries(sql, baseline, repeats=3)
+        ex = sql("select avoided_parts, fallback_parts from"
+                 " gv$px_exchange where mode = 'pushdown'"
+                 " order by ts desc limit 1")
+        av, fb = rows_of(ex)[0]
+        out["scenarios"]["nodekill_restart"] = {
+            "parity": bool(parity and marker_ok and xa_recoverable
+                           and xa_row == [(1,)]),
+            "p99_s": round(p99(lat), 3), "queries": len(lat),
+            "hung": hung, "restart_s": round(restart_s, 2),
+            "detector_state": "up", "marker_on_restarted_node": marker_ok,
+            "xa_recoverable": xa_recoverable,
+            "xa_committed_row": xa_row == [(1,)],
+            "avoided_parts": int(av), "fallback_parts": int(fb),
+            "boot_phases": sorted({e["phase"]
+                                   for e in rec.get("events", [])})}
+
+        # ---- scenario 5: wipe the node's data dir, rebuild ---------
+        # zero local recovery sources: bootstrap over the chunked
+        # rebuild.fetch_meta / rebuild.fetch_segments verbs from a
+        # peer's checkpoint + segments + WAL, then WAL-tail catch-up
+        procs[3].send_signal(signal.SIGKILL)
+        procs[3].wait(timeout=10)
+        shutil.rmtree(os.path.join(root, "n3"), ignore_errors=True)
+        write_config(3)
+        t0 = time.monotonic()
+        start_node(3)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if clients[3].ping():
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("wiped node 3 never came up")
+        if not wait_detector(c1, 3, ("up",), timeout=30):
+            raise TimeoutError("detector never flipped rebuilt node up")
+        wait_converged(clients, "lineitem", n_rows + 2)
+        rebuild_s = time.monotonic() - t0
+        rec = clients[3].call("recovery.state")
+        ev = {e["phase"]: e for e in rec.get("events", [])}
+        served = rows_of(weak3(QUERIES["q6"]))
+        parity, lat, hung = run_queries(sql, baseline, repeats=3)
+        out["scenarios"]["wipe_rebuild"] = {
+            "parity": bool(parity and served == baseline["q6"]
+                           and "rebuild" in ev),
+            "p99_s": round(p99(lat), 3), "queries": len(lat) + 1,
+            "hung": hung, "rebuild_s": round(rebuild_s, 2),
+            "served_by_rebuilt_node": served == baseline["q6"],
+            "rebuild_bytes": int(ev.get("rebuild", {}).get("bytes", 0)),
+            "rebuild_files": int(ev.get("rebuild", {}).get("entries", 0)),
+            "rebuild_peer": int(ev.get("rebuild", {}).get("peer", -1))}
+
         out["parity_all"] = all(s["parity"]
                                 for s in out["scenarios"].values())
         out["hung_total"] = sum(s["hung"]

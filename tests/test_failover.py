@@ -1,9 +1,12 @@
 """Full-stack failure scenarios: WAL leader loss mid-workload, errsim
 fault storms (≙ mittest errsim failover suites, SURVEY §5.3), and —
-over a real 3-process cluster — failure-detector-driven re-election and
-suspect-node slice avoidance (net/health.py + net/faults.py).
+over a real 3-process cluster — failure-detector-driven re-election,
+suspect-node slice avoidance (net/health.py + net/faults.py), and the
+crash-recovery plane: kill→restart→rejoin, wipe→rebuild, and durable XA
+across leader failover (net/rebuild.py, tx/service.py recovery).
 """
 
+import shutil
 import time
 
 import pytest
@@ -215,5 +218,193 @@ def test_suspect_node_slice_avoidance_parity(tmp_path):
             time.sleep(0.2)
         else:
             raise AssertionError("breaker never recovered")
+    finally:
+        c.close()
+
+# ---------------------------------------------------------------------------
+# crash recovery & rejoin: restart replay, wiped-replica rebuild, durable XA
+# ---------------------------------------------------------------------------
+
+
+def _wait(fn, timeout=60, period=0.25, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return
+        except Exception:
+            pass
+        time.sleep(period)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _weak_count(c, i, table):
+    r = c.execute(i, f"select count(*) from {table}",
+                  consistency="weak")
+    return (int(c.rows(r)[0][0]), r["node"])
+
+
+@pytest.mark.slow
+def test_nodekill_restart_rejoin(tmp_path):
+    """SIGKILL a follower, restart the process: it replays its WAL,
+    rejoins the palf group without disturbing the term, catches up via
+    the leader's push protocol, the detector flips down→up within a
+    heartbeat, and DTL routing sends slices back to it (avoided_parts
+    returns to 0).  A row committed immediately before the kill must be
+    readable FROM the restarted node."""
+    from test_multinode import Cluster
+
+    c = Cluster(tmp_path, n=3)
+    try:
+        c.execute(1, "create table t (k int primary key, v int)")
+        for s in range(0, 1000, 250):
+            vals = ", ".join(f"({i}, {i * 2})"
+                             for i in range(s, s + 250))
+            c.execute(1, f"insert into t values {vals}")
+        c.execute(1, "alter system set dtl_min_rows = 1")
+        # the row committed right before the kill
+        c.execute(1, "insert into t values (99999, 7)")
+        _wait(lambda: _weak_count(c, 3, "t") == (1001, 3),
+              msg="node 3 pre-kill convergence")
+        st_before = c.clients[1].call("palf.state")
+
+        c.kill(3)
+        # writes continue while node 3 is dead
+        c.execute(1, "insert into t values (99998, 8)")
+        c.start_node(3)
+        c.wait_ready()
+        _wait(lambda: _weak_count(c, 3, "t") == (1002, 3),
+              msg="restarted node catch-up")
+        # pre-kill marker served BY the restarted node
+        r = c.execute(3, "select v from t where k = 99999",
+                      consistency="weak")
+        assert r["node"] == 3 and c.rows(r) == [(7,)]
+        # the rejoin did not disturb the term (no takeover election)
+        st_after = c.clients[1].call("palf.state")
+        assert st_after["role"] == "leader"
+        assert st_after["term"] == st_before["term"]
+        # detector returns to up...
+        def _up():
+            h = c.clients[1].call("cluster.health")
+            return {x["peer"]: x["state"]
+                    for x in h["peers"]}[3] == "up"
+        _wait(_up, timeout=20, msg="detector down→up")
+        # ...and a fresh pushdown query routes slices to node 3 again
+        q = "select sum(v), count(*) from t where k < 500"
+        c.execute(1, q)
+        ex = c.execute(
+            1, "select avoided_parts, fallback_parts from gv$px_exchange"
+               " where mode = 'pushdown' order by ts desc limit 1")
+        avoided, fallbacks = c.rows(ex)[0]
+        assert (avoided, fallbacks) == (0, 0)
+        # the restarted node's gv$recovery names its boot
+        rec = c.clients[3].call("recovery.state")
+        phases = [e["phase"] for e in rec["events"]]
+        assert "boot_replay" in phases
+        assert rec["applied_lsn"] == rec["committed_lsn"] > 0
+    finally:
+        c.close()
+
+
+@pytest.mark.slow
+def test_wipe_rebuild_reaches_parity(tmp_path):
+    """Empty a node's data dir entirely: it bootstraps from a peer's
+    checkpoint + segments + WAL over the chunked rebuild verbs, then
+    catches up from the leader — zero local recovery sources needed."""
+    import numpy as np
+
+    from test_multinode import Cluster
+
+    c = Cluster(tmp_path, n=3)
+    try:
+        c.execute(1, "create table q (k int primary key, v int)")
+        rng = np.random.default_rng(11)
+        v = rng.integers(0, 1000, 1500)
+        for s in range(0, 1500, 500):
+            vals = ", ".join(f"({i}, {v[i]})"
+                             for i in range(s, s + 500))
+            c.execute(1, f"insert into q values {vals}")
+        _wait(lambda: _weak_count(c, 3, "q") == (1500, 3),
+              msg="node 3 pre-wipe convergence")
+
+        c.kill(3)
+        shutil.rmtree(tmp_path / "node3", ignore_errors=True)
+        c.execute(1, "insert into q values (50000, 1)")
+        c.start_node(3)
+        c.wait_ready(timeout=90)
+        _wait(lambda: _weak_count(c, 3, "q") == (1501, 3),
+              timeout=90, msg="wiped node parity")
+        # bit-identical content, served by the rebuilt node
+        r = c.execute(3, "select sum(v) from q", consistency="weak")
+        assert r["node"] == 3
+        assert c.rows(r)[0][0] == int(v.sum()) + 1
+        # the rebuild is byte-accounted and names its source peer
+        rec = c.clients[3].call("recovery.state")
+        ev = {e["phase"]: e for e in rec["events"]}
+        assert "rebuild" in ev
+        assert ev["rebuild"]["bytes"] > 0
+        assert ev["rebuild"]["peer"] in (1, 2)
+        # gv$recovery through SQL mirrors the wire snapshot
+        rows = c.rows(c.execute(
+            3, "select phase, bytes from gv$recovery"
+               " where phase = 'rebuild'", consistency="weak"))
+        assert rows and rows[0][1] == ev["rebuild"]["bytes"]
+    finally:
+        c.close()
+
+
+@pytest.mark.slow
+def test_xa_prepared_survives_leader_failover(tmp_path):
+    """Durable XA across node death: a branch prepared on the leader is
+    recoverable on the SURVIVORS (they replayed its redo+prepare
+    records), commits there after failover, and the restarted old
+    leader converges to the committed result."""
+    from test_multinode import Cluster
+
+    c = Cluster(tmp_path, n=3)
+    try:
+        c.execute(1, "create table t (k int primary key, v int)")
+        c.execute(1, "insert into t values (1, 10)")
+        c.execute(1, "xa start 'fx1'")
+        c.execute(1, "insert into t values (2, 20)")
+        c.execute(1, "xa end 'fx1'")
+        c.execute(1, "xa prepare 'fx1'")
+        _wait(lambda: "fx1" in c.clients[3].call(
+            "recovery.state")["prepared_xids"],
+            timeout=20, msg="follower registers prepared branch")
+
+        c.kill(1)
+        # a survivor takes over...
+        def _new_leader():
+            for i in (2, 3):
+                st = c.clients[i].call("palf.state", _deadline_s=1.0)
+                if st["role"] == "leader":
+                    return i
+            return None
+        _wait(lambda: _new_leader() is not None, timeout=30,
+              msg="re-election")
+        leader = _new_leader()
+        # ...reports the branch recoverable and commits it
+        assert "fx1" in c.clients[leader].call(
+            "recovery.state")["prepared_xids"]
+        def _commit():
+            c.execute(leader, "xa commit 'fx1'")
+            return True
+        _wait(_commit, timeout=30, msg="xa commit after failover")
+        r = c.execute(leader, "select k, v from t order by k")
+        assert c.rows(r) == [(1, 10), (2, 20)]
+
+        # the old leader restarts, replays its own prepare records,
+        # then retires the branch when catch-up ships the commit
+        c.start_node(1)
+        c.wait_ready()
+        _wait(lambda: _weak_count(c, 1, "t") == (2, 1),
+              msg="old leader catch-up")
+        r = c.execute(1, "select k, v from t order by k",
+                      consistency="weak")
+        assert c.rows(r) == [(1, 10), (2, 20)]
+        _wait(lambda: c.clients[1].call(
+            "recovery.state")["prepared_xids"] == [],
+            timeout=20, msg="old leader retires the branch")
     finally:
         c.close()

@@ -71,6 +71,9 @@ def test_palf_log_corruption_detected(tmp_path):
     c = PalfCluster(3, log_root=root)
     c.elect()
     c.append([b"good1", b"good2", b"good3"])
+    # a lease lapse between elect() and append() may insert an extra
+    # noop: measure the actual log length instead of assuming it
+    n_before = c.replicas[1].last_lsn()
     c.close()
     # corrupt the tail of replica 1's log
     import os
@@ -82,9 +85,9 @@ def test_palf_log_corruption_detected(tmp_path):
     c2 = PalfCluster(3, log_root=root)
     r1 = c2.replicas[1]
     # the corrupt tail entry is dropped, earlier entries survive
-    assert r1.last_lsn() < 4
+    assert r1.last_lsn() == n_before - 1
     payloads = [e.payload for e in r1.entries]
-    assert b"good3" not in payloads or len(payloads) < 4
+    assert b"good3" not in payloads
     # the cluster still elects and catches the replica up from peers
     c2.elect()
     c2.tick()

@@ -1,0 +1,455 @@
+"""Crash recovery: restart replay, durable XA reconstruction, torn-tail
+truncation, and checkpoint-bounded replay work.
+
+≙ the reference's restart/HA suites: slog+checkpoint boot
+(ob_server_checkpoint_slog_handler), XA crash recovery into prepared
+state (src/storage/tx/ob_xa_service.h), and the palf log tail scan.
+All deterministic and in-process (tier-1); the cluster-level
+kill→restart→rejoin and wipe→rebuild scenarios live in
+tests/test_failover.py -m slow.
+"""
+
+import os
+
+import pytest
+
+from oceanbase_tpu.palf.log import LogEntry, PalfReplica
+from oceanbase_tpu.server import Database
+
+
+def _crash(db):
+    """Simulate a crash: abandon the process state WITHOUT checkpoint or
+    graceful close (the WAL and slog are all recovery gets)."""
+    db.ash.stop()
+    db.jobs.stop()
+
+
+# ---------------------------------------------------------------------------
+# restart replay
+# ---------------------------------------------------------------------------
+
+
+def test_restart_replays_committed_writes(tmp_path):
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 10), (2, 20), (3, 30)")
+    s.execute("update t set v = 99 where k = 2")
+    s.execute("delete from t where k = 3")
+    _crash(db)
+
+    db2 = Database(root)
+    rows = db2.session().execute("select k, v from t order by k").rows()
+    assert rows == [(1, 10), (2, 99)]
+    # a second generation of writes + crash replays on top
+    db2.session().execute("insert into t values (4, 40)")
+    _crash(db2)
+    db3 = Database(root)
+    rows = db3.session().execute("select k, v from t order by k").rows()
+    assert rows == [(1, 10), (2, 99), (4, 40)]
+    db3.close()
+
+
+def test_checkpoint_bounds_replay_work(tmp_path):
+    """After a checkpoint, restart replay covers only the WAL tail —
+    O(tail), not O(history)."""
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    for i in range(40):
+        s.execute(f"insert into t values ({i}, {i})")
+    db.checkpoint()
+    for i in range(40, 45):
+        s.execute(f"insert into t values ({i}, {i})")
+    _crash(db)
+
+    db2 = Database(root)
+    s2 = db2.session()
+    assert s2.execute("select count(*) from t").rows()[0][0] == 45
+    ev = db2.tenant("sys").recovery.last("boot_replay")
+    assert ev is not None
+    # the replay point moved past the pre-checkpoint history: the tail
+    # (5 inserts = 5 redo + 5 commit records) is all that replays
+    assert ev["wal_start_lsn"] > 0
+    assert 0 < ev["entries"] <= 12
+    rows = s2.execute(
+        "select phase, wal_start_lsn, entries from gv$recovery"
+        " where phase = 'boot_replay'").rows()
+    assert rows and rows[-1][1] == ev["wal_start_lsn"]
+    db2.close()
+
+
+def test_restart_tx_ids_do_not_collide(tmp_path):
+    """Replayed transaction ids seed the allocator: a new tx must not
+    reuse a replayed id (a reconstructed prepared branch keys its
+    uncommitted state by tx id)."""
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table t (k int primary key)")
+    for i in range(5):
+        s.execute(f"insert into t values ({i})")
+    s.execute("xa start 'c1'")
+    s.execute("insert into t values (100)")
+    s.execute("xa end 'c1'")
+    s.execute("xa prepare 'c1'")
+    _crash(db)
+
+    db2 = Database(root)
+    svc = db2.tenant("sys").tx
+    branch = svc.xa_transactions["c1"]
+    tx = svc.begin()
+    assert tx.tx_id > branch.tx_id
+    svc.rollback(tx)
+    db2.close()
+
+
+# ---------------------------------------------------------------------------
+# durable XA
+# ---------------------------------------------------------------------------
+
+
+def test_prepared_xa_branch_survives_crash_and_commits(tmp_path):
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 10)")
+    s.execute("xa start 'x1'")
+    s.execute("insert into t values (2, 20)")
+    s.execute("update t set v = 11 where k = 1")
+    s.execute("xa end 'x1'")
+    s.execute("xa prepare 'x1'")
+    # a branch that COMMITTED before the crash must not resurface
+    s.execute("xa start 'x2'")
+    s.execute("insert into t values (3, 30)")
+    s.execute("xa end 'x2'")
+    s.execute("xa prepare 'x2'")
+    s.execute("xa commit 'x2'")
+    _crash(db)
+
+    db2 = Database(root)
+    s2 = db2.session()
+    # prepared-but-uncommitted writes stay invisible...
+    assert s2.execute("select k, v from t order by k").rows() == \
+        [(1, 10), (3, 30)]
+    # ...but the branch is RECOVERABLE, not rolled back
+    assert s2.execute("xa recover").rows() == [("x1",)]
+    rows = s2.execute("select xids from gv$recovery"
+                      " where phase = 'restore_prepared'").rows()
+    assert rows == [("x1",)]
+    s2.execute("xa commit 'x1'")
+    assert s2.execute("select k, v from t order by k").rows() == \
+        [(1, 11), (2, 20), (3, 30)]
+    assert s2.execute("xa recover").rows() == []
+    _crash(db2)
+    # the recovered commit is itself durable
+    db3 = Database(root)
+    s3 = db3.session()
+    assert s3.execute("select k, v from t order by k").rows() == \
+        [(1, 11), (2, 20), (3, 30)]
+    assert s3.execute("xa recover").rows() == []
+    db3.close()
+
+
+def test_prepared_xa_branch_recovered_rollback(tmp_path):
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table t (k int primary key)")
+    s.execute("xa start 'r1'")
+    s.execute("insert into t values (7)")
+    s.execute("xa end 'r1'")
+    s.execute("xa prepare 'r1'")
+    _crash(db)
+
+    db2 = Database(root)
+    s2 = db2.session()
+    assert s2.execute("xa recover").rows() == [("r1",)]
+    s2.execute("xa rollback 'r1'")
+    assert s2.execute("select count(*) from t").rows()[0][0] == 0
+    # the xid frees up and the rollback is durable
+    _crash(db2)
+    db3 = Database(root)
+    s3 = db3.session()
+    assert s3.execute("xa recover").rows() == []
+    assert s3.execute("select count(*) from t").rows()[0][0] == 0
+    s3.execute("xa start 'r1'")
+    s3.execute("insert into t values (8)")
+    s3.execute("xa end 'r1'")
+    s3.execute("xa commit 'r1' one phase")
+    assert s3.execute("select k from t").rows() == [(8,)]
+    db3.close()
+
+
+def test_prepared_xa_survives_checkpoint_then_crash(tmp_path):
+    """The checkpoint replay point clamps at a pending prepared branch:
+    its redo lives ONLY in the WAL, so advancing past the prepare batch
+    would lose the branch at the next restart."""
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("xa start 'k1'")
+    s.execute("insert into t values (5, 50)")
+    s.execute("xa end 'k1'")
+    s.execute("xa prepare 'k1'")
+    # unrelated committed traffic + a checkpoint AFTER the prepare
+    s.execute("insert into t values (6, 60)")
+    db.checkpoint()
+    svc = db.tenant("sys").tx
+    assert svc.min_prepared_lsn() is not None
+    assert db.engine.meta["wal_lsn"] <= svc.min_prepared_lsn()
+    _crash(db)
+
+    db2 = Database(root)
+    s2 = db2.session()
+    assert s2.execute("xa recover").rows() == [("k1",)]
+    s2.execute("xa commit 'k1'")
+    assert s2.execute("select k, v from t order by k").rows() == \
+        [(5, 50), (6, 60)]
+    # committing released the clamp: the next checkpoint advances
+    db2.checkpoint()
+    assert db2.tenant("sys").tx.min_prepared_lsn() is None
+    _crash(db2)
+    db3 = Database(root)
+    assert db3.session().execute(
+        "select k, v from t order by k").rows() == [(5, 50), (6, 60)]
+    db3.close()
+
+
+# ---------------------------------------------------------------------------
+# palf torn-tail truncation
+# ---------------------------------------------------------------------------
+
+
+def test_torn_tail_truncate_roundtrip(tmp_path):
+    """Appends after a torn tail must survive the NEXT recovery: the
+    file physically truncates to the last valid entry before append
+    mode reopens (the old behavior wrote new entries after the garbage,
+    where the next recovery's scan never reached them)."""
+    d = str(tmp_path)
+    r = PalfReplica(1, d)
+    r.role = "leader"
+    r.leader_append([b"a", b"b"])
+    r.close()
+    path = os.path.join(d, "replica_1.log")
+    size_clean = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b"\x07torn-partial-entry")
+
+    r2 = PalfReplica(1, d)
+    assert [e.payload for e in r2.entries] == [b"a", b"b"]
+    assert os.path.getsize(path) == size_clean  # garbage truncated
+    r2.role = "leader"
+    r2.current_term = r2.entries[-1].term
+    r2.leader_append([b"c"])
+    r2.close()
+
+    r3 = PalfReplica(1, d)
+    assert [e.payload for e in r3.entries] == [b"a", b"b", b"c"]
+    r3.close()
+
+
+def test_torn_tail_corrupt_crc(tmp_path):
+    """A bit-flipped tail entry truncates; earlier entries survive."""
+    d = str(tmp_path)
+    r = PalfReplica(1, d)
+    r.role = "leader"
+    entries = r.leader_append([b"aaaa", b"bbbb"])
+    r.close()
+    path = os.path.join(d, "replica_1.log")
+    # flip a payload byte of the LAST entry (crc now mismatches)
+    with open(path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        f.write(b"\xff")
+    r2 = PalfReplica(1, d)
+    assert [e.payload for e in r2.entries] == [b"aaaa"]
+    assert entries[0].lsn == 1
+    r2.role = "leader"
+    r2.leader_append([b"cccc"])
+    r2.close()
+    r3 = PalfReplica(1, d)
+    assert [e.payload for e in r3.entries] == [b"aaaa", b"cccc"]
+    r3.close()
+
+
+def test_unreadable_log_quarantined(tmp_path):
+    """A log with a foreign magic is moved aside, never appended after."""
+    d = str(tmp_path)
+    path = os.path.join(d, "replica_1.log")
+    with open(path, "wb") as f:
+        f.write(b"NOTMAGIC" + b"\x00" * 64)
+    r = PalfReplica(1, d)
+    assert r.entries == []
+    r.role = "leader"
+    r.leader_append([b"x"])
+    r.close()
+    assert os.path.exists(path + ".corrupt")
+    r2 = PalfReplica(1, d)
+    assert [e.payload for e in r2.entries] == [b"x"]
+    r2.close()
+
+
+def test_follower_accept_after_torn_tail(tmp_path):
+    """The follower path persists through the truncated tail too."""
+    d = str(tmp_path)
+    r = PalfReplica(2, d)
+    r.accept(0, 0, [LogEntry(1, 1, b"p1"), LogEntry(1, 2, b"p2")])
+    r.close()
+    path = os.path.join(d, "replica_2.log")
+    with open(path, "ab") as f:
+        f.write(b"junk")
+    r2 = PalfReplica(2, d)
+    assert r2.last_lsn() == 2
+    assert r2.accept(2, 1, [LogEntry(1, 3, b"p3")])
+    r2.close()
+    r3 = PalfReplica(2, d)
+    assert [e.payload for e in r3.entries] == [b"p1", b"p2", b"p3"]
+    r3.close()
+
+
+# ---------------------------------------------------------------------------
+# failure detector satellite: transition timestamps + prompt down→up
+# ---------------------------------------------------------------------------
+
+
+def test_health_transition_ts_and_prompt_recovery():
+    from oceanbase_tpu.net.health import DOWN, UP, HealthMonitor
+
+    mon = HealthMonitor(1, {}, suspect_after=2, down_after=4)
+    mon.observer(9)
+    row = mon.snapshot()[0]
+    assert row["last_transition_ts"] == 0.0
+    for _ in range(4):
+        mon.record_failure(9)
+    row = mon.snapshot()[0]
+    assert row["state"] == DOWN
+    t_down = row["last_transition_ts"]
+    assert t_down > 0
+    # ONE success flips the breaker straight back to up
+    mon.record_success(9, 0.001)
+    row = mon.snapshot()[0]
+    assert row["state"] == UP
+    assert row["last_transition_ts"] >= t_down
+    assert row["consecutive_failures"] == 0
+
+
+def test_rebuild_policies_registered():
+    """Standing contract: every rebuild/recovery verb has a POLICIES
+    entry; the chunked fetches are idempotent with a retry budget."""
+    from oceanbase_tpu.net.rpc import POLICIES
+
+    for verb in ("rebuild.fetch_meta", "rebuild.fetch_segments",
+                 "recovery.state"):
+        assert verb in POLICIES, verb
+        assert POLICIES[verb].idempotent
+        assert POLICIES[verb].max_retries >= 1
+
+
+def test_needs_rebuild_detection(tmp_path):
+    from oceanbase_tpu.net.rebuild import needs_rebuild
+
+    root = str(tmp_path)
+    assert needs_rebuild(root, 3)  # nothing at all
+    # a non-trivial WAL is a local recovery source: no rebuild
+    os.makedirs(os.path.join(root, "wal"))
+    with open(os.path.join(root, "wal", "replica_3.log"), "wb") as f:
+        f.write(b"OBTPULG1" + b"\x01" * 32)
+    assert not needs_rebuild(root, 3)
+    os.remove(os.path.join(root, "wal", "replica_3.log"))
+    assert needs_rebuild(root, 3)
+    # a manifest alone is a recovery source too
+    os.makedirs(os.path.join(root, "data"))
+    with open(os.path.join(root, "data", "manifest.json"), "w") as f:
+        f.write("{}")
+    assert not needs_rebuild(root, 3)
+
+
+def test_gv_recovery_catchup_row_absent_single_node(tmp_path):
+    """The live catchup row is cluster-only; the single-node surface
+    still serves the table (schema intact, events present)."""
+    db = Database(str(tmp_path / "db"))
+    s = db.session()
+    s.execute("create table t (k int primary key)")
+    db.checkpoint()
+    rows = s.execute(
+        "select phase from gv$recovery order by ts").rows()
+    phases = [r[0] for r in rows]
+    assert "checkpoint" in phases
+    assert "catchup" not in phases
+    db.close()
+
+
+def test_recovered_branch_blocks_conflicting_writes(tmp_path):
+    """A reconstructed prepared branch keeps its lock-like presence: a
+    concurrent write to its keys conflicts (as it would have before the
+    crash) instead of silently racing the pending XA COMMIT."""
+    from oceanbase_tpu.tx.errors import WriteConflict
+
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table t (k int primary key, v int)")
+    s.execute("insert into t values (1, 10)")
+    s.execute("xa start 'b1'")
+    s.execute("update t set v = 11 where k = 1")
+    s.execute("xa end 'b1'")
+    s.execute("xa prepare 'b1'")
+    _crash(db)
+
+    db2 = Database(root)
+    s2 = db2.session()
+    assert s2.execute("xa recover").rows() == [("b1",)]
+    with pytest.raises(WriteConflict):
+        s2.execute("update t set v = 99 where k = 1")
+    # an unrelated key is untouched by the branch's presence
+    s2.execute("insert into t values (2, 20)")
+    s2.execute("xa commit 'b1'")
+    assert s2.execute("select k, v from t order by k").rows() == \
+        [(1, 11), (2, 20)]
+    # after the commit the key writes normally again
+    s2.execute("update t set v = 12 where k = 1")
+    assert s2.execute("select v from t where k = 1").rows() == [(12,)]
+    db2.close()
+
+
+def test_rebuild_resolve_refuses_traversal(tmp_path):
+    from oceanbase_tpu.net.rebuild import RebuildServer
+
+    class _N:
+        root = str(tmp_path)
+        node_id = 3
+
+    srv = RebuildServer(_N())
+    for bad in ("data/../config.json", "/etc/passwd",
+                "data/../../x", "wal/replica_1.log", "config.json"):
+        with pytest.raises(PermissionError):
+            srv._resolve(bad)
+    ok = srv._resolve("data/segments/t_1.npz")
+    assert ok.endswith(os.path.join("data", "segments", "t_1.npz"))
+
+
+def test_xa_branch_without_prepare_still_rolls_back(tmp_path):
+    """An ACTIVE (never prepared) XA branch dies with the crash — only
+    PREPARED branches recover (the XA contract)."""
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    s.execute("create table t (k int primary key)")
+    s.execute("xa start 'a1'")
+    s.execute("insert into t values (1)")
+    s.execute("xa end 'a1'")
+    _crash(db)  # no prepare: redo never reached the WAL
+
+    db2 = Database(root)
+    s2 = db2.session()
+    assert s2.execute("xa recover").rows() == []
+    assert s2.execute("select count(*) from t").rows()[0][0] == 0
+    db2.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))
