@@ -18,6 +18,9 @@ Three AST checkers plus one dynamic verifier:
 - ``lock_order``       — lock-acquisition graph inversions and shared-
                          dict mutation outside any held lock (rules
                          ``lock.*``);
+- ``metric_rules``     — metrics-plane discipline: no updates in
+                         jit-reachable code, every series name a
+                         registered literal (rules ``metric.*``);
 - ``poison``           — the executable half: fill pad lanes with
                          NaN/sentinel garbage and assert bit-identical
                          results.
@@ -38,6 +41,7 @@ from oceanbase_tpu.analysis.core import (
 )
 from oceanbase_tpu.analysis.lock_order import check_lock_order
 from oceanbase_tpu.analysis.mask_discipline import check_mask_discipline
+from oceanbase_tpu.analysis.metric_rules import check_metric_rules
 from oceanbase_tpu.analysis.trace_safety import check_trace_safety
 
 __all__ = [
@@ -45,6 +49,7 @@ __all__ = [
     "Finding",
     "check_lock_order",
     "check_mask_discipline",
+    "check_metric_rules",
     "check_trace_safety",
     "diff_findings",
     "load_baseline",

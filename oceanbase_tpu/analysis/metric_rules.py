@@ -1,0 +1,145 @@
+"""metric checker: metrics-plane discipline (rules ``metric.*``).
+
+The metrics registry (server/metrics.py) buys its ≤2% overhead budget
+with two contracts this checker enforces statically:
+
+- **host-side only** (``metric.jit-reachable``) — a metric update inside
+  jit-traced code would either run once at trace time (silently wrong
+  counts) or force a host sync per execution; updates belong at the same
+  result/span-close boundaries the trace spans instrument.  The scope is
+  the SAME computed closure trace_safety uses: functions reachable from
+  ``jax.jit``/``shard_map`` roots.
+- **declared names only** (``metric.undeclared`` /
+  ``metric.dynamic-name``) — every series name passed to
+  ``inc``/``observe``/``set_gauge`` must be a string literal registered
+  by a ``declare(...)`` call somewhere in the package (or a module-level
+  constant bound to a ``declare(...)`` result).  A dynamically formatted
+  name (f-string, ``%``/``+``/``.format`` build, loop variable) can typo
+  itself into a fresh series that nothing ever reads — the cardinality
+  leak Prometheus operators know too well.
+
+Both rules fire only on calls that resolve to the metrics module
+(``from oceanbase_tpu.server import metrics [as qmetrics]`` attribute
+calls, or names from-imported out of ``oceanbase_tpu.server.metrics``);
+an unrelated object's ``.observe(...)`` is not our business.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from oceanbase_tpu.analysis.core import Analyzer, Finding
+from oceanbase_tpu.analysis.trace_safety import (
+    _device_scope,
+    _Index,
+    _traced_roots,
+)
+
+METRICS_MODULE = "oceanbase_tpu.server.metrics"
+UPDATE_FNS = ("inc", "observe", "set_gauge")
+
+
+def _metrics_aliases(idx: _Index, path: str) -> set[str]:
+    """Local names that refer to the metrics MODULE in ``path``
+    (``import ... as qmetrics`` / ``from oceanbase_tpu.server import
+    metrics``)."""
+    out: set[str] = set()
+    for alias, mod in idx.alias.get(path, {}).items():
+        if mod == METRICS_MODULE:
+            out.add(alias)
+    for alias, (mod, orig) in idx.from_imp.get(path, {}).items():
+        if f"{mod}.{orig}" == METRICS_MODULE:
+            out.add(alias)
+    return out
+
+
+def _direct_imports(idx: _Index, path: str) -> dict[str, str]:
+    """{local name: metrics function} for ``from ...metrics import inc``."""
+    out: dict[str, str] = {}
+    for alias, (mod, orig) in idx.from_imp.get(path, {}).items():
+        if mod == METRICS_MODULE and orig in UPDATE_FNS + ("declare",):
+            out[alias] = orig
+    return out
+
+
+def _classify_call(idx: _Index, path: str, call: ast.Call) -> str | None:
+    """-> 'inc' | 'observe' | 'set_gauge' | 'declare' when ``call`` is a
+    metrics-module call, else None."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.value.id in _metrics_aliases(idx, path) and \
+                f.attr in UPDATE_FNS + ("declare",):
+            return f.attr
+        return None
+    if isinstance(f, ast.Name):
+        return _direct_imports(idx, path).get(f.id)
+    return None
+
+
+def _declared_names(idx: _Index) -> tuple[set[str], set[tuple[str, str]]]:
+    """Collect the registry: literal first arguments of every
+    ``declare(...)`` call, plus (path, name) pairs for module-level
+    constants bound to a declare() result (``M_FOO = declare("foo",
+    ...)`` — declare returns the name)."""
+    names: set[str] = set()
+    consts: set[tuple[str, str]] = set()
+    for path, tree in idx.az.trees.items():
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Call) and \
+                    _classify_call(idx, path, n) == "declare":
+                if n.args and isinstance(n.args[0], ast.Constant) \
+                        and isinstance(n.args[0].value, str):
+                    names.add(n.args[0].value)
+            if isinstance(n, ast.Assign) and \
+                    isinstance(n.value, ast.Call) and \
+                    _classify_call(idx, path, n.value) == "declare":
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        consts.add((path, t.id))
+    return names, consts
+
+
+def check_metric_rules(az: Analyzer) -> list[Finding]:
+    idx = _Index(az)
+    scope = _device_scope(idx, _traced_roots(idx))
+    declared, consts = _declared_names(idx)
+    # metrics.py itself implements the registry — its internal calls are
+    # the machinery, not call sites
+    metrics_path = None
+    for p in az.trees:
+        if p.endswith("server/metrics.py"):
+            metrics_path = p
+    out: list[Finding] = []
+    for (path, qual), info in idx.funcs.items():
+        if path == metrics_path:
+            continue
+        for call in info.calls:
+            kind = _classify_call(idx, path, call)
+            if kind is None or kind == "declare":
+                continue
+            if (path, qual) in scope:
+                out.append(Finding(
+                    "metric.jit-reachable", path, call.lineno, qual,
+                    f"metrics.{kind}(...) in jit-reachable code: the "
+                    f"update runs at trace time (wrong counts) or syncs "
+                    f"the host per execution — move it to the result "
+                    f"boundary"))
+            if not call.args:
+                continue
+            a = call.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                if a.value not in declared:
+                    out.append(Finding(
+                        "metric.undeclared", path, call.lineno, qual,
+                        f"metric name {a.value!r} was never "
+                        f"declare()d: updates to it raise at runtime"))
+            elif isinstance(a, ast.Name) and (path, a.id) in consts:
+                pass  # module-level NAME = declare("...") constant
+            else:
+                out.append(Finding(
+                    "metric.dynamic-name", path, call.lineno, qual,
+                    f"dynamically built metric name "
+                    f"({ast.unparse(a)[:60]}): a typo mints a fresh "
+                    f"series silently — use a declared literal (put "
+                    f"variability in labels)"))
+    return out

@@ -25,8 +25,28 @@ import jax
 from oceanbase_tpu.exec import diag, ops
 from oceanbase_tpu.exec.ops import AggSpec
 from oceanbase_tpu.expr import ir
+from oceanbase_tpu.server import metrics as qmetrics
 from oceanbase_tpu.server import trace as qtrace
 from oceanbase_tpu.vector.column import Relation
+
+# device attribution + per-plan wall time (host-side, result boundary)
+qmetrics.declare("plan.executions", "counter",
+                 "execute_plan calls", )
+qmetrics.declare("plan.compiles", "counter",
+                 "XLA trace+compile events (per plan x input signature)")
+qmetrics.declare("plan.execute_s", "histogram",
+                 "whole-plan execution wall time", unit="s")
+qmetrics.declare("plan.compile_s", "histogram",
+                 "XLA lower+compile wall time", unit="s")
+qmetrics.declare("plan.flops_compiled", "counter",
+                 "XLA cost_analysis flops of freshly compiled programs")
+qmetrics.declare("plan.bytes_compiled", "counter",
+                 "XLA cost_analysis bytes-accessed of compiled programs")
+qmetrics.declare("plan.flops_executed", "counter",
+                 "cost_analysis flops of the program behind each "
+                 "execution (measured device work, the CBO's substrate)")
+qmetrics.declare("plan.bytes_executed", "counter",
+                 "cost_analysis bytes-accessed per execution")
 
 
 # ---------------------------------------------------------------------------
@@ -41,13 +61,20 @@ class PlanCacheEntry:
     ``xla_traces`` counts XLA retrace events — the expensive part the
     shape-bucket policy amortizes; ``executions - xla_traces`` is the
     number of calls served entirely by an already-compiled executable.
+    ``flops``/``bytes_accessed``/``peak_memory`` come from XLA's
+    ``cost_analysis()``/``memory_analysis()`` on the most recently
+    compiled signature — the measured statistics the cost-based
+    optimizer arc prices against.
     """
 
     plan_hash: str            # stable digest of the plan fingerprint
     plan_text: str            # fingerprint prefix (human-readable)
     executions: int = 0       # execute_plan calls for this fingerprint
     xla_traces: int = 0       # trace (compile) events across all shapes
-    last_compile_s: float = 0.0  # wall time of the last traced execution
+    last_compile_s: float = 0.0  # wall time of the last lower+compile
+    flops: float = 0.0        # cost_analysis flops (last compile)
+    bytes_accessed: float = 0.0  # cost_analysis bytes (last compile)
+    peak_memory: int = 0      # memory_analysis arg+temp+output bytes
     created_ts: float = field(default_factory=time.time)
 
     @property
@@ -302,46 +329,157 @@ def referenced_tables(node: PlanNode) -> set[str]:
     return out
 
 
+def _input_signature(tables: dict[str, Relation]) -> tuple:
+    """Hashable signature equivalent to jit's dispatch key for a
+    {name -> Relation} input: table/column names, leaf shapes + dtypes
+    (+ weak_type), validity/mask presence, and the static aux metadata
+    (SqlType, content-hashed StringDict).  Two inputs with equal
+    signatures lower to the same XLA program; a cheaper hand-rolled walk
+    than ``jax.tree_util.tree_flatten`` + abstractify on the hot path."""
+    parts = []
+    for tname in sorted(tables):
+        rel = tables[tname]
+        m = rel.mask
+        p: list = [tname,
+                   None if m is None else (m.shape, str(m.dtype))]
+        cols = rel.columns
+        for cname in sorted(cols):
+            c = cols[cname]
+            v = c.valid
+            d = c.data
+            p.append((cname, d.shape, str(d.dtype),
+                      bool(getattr(d, "weak_type", False)),
+                      None if v is None else (v.shape, str(v.dtype)),
+                      c.dtype, c.sdict))
+        parts.append(tuple(p))
+    return tuple(parts)
+
+
+def _xla_analysis(exe) -> tuple[float, float, int]:
+    """-> (flops, bytes_accessed, peak_memory_bytes) from the compiled
+    executable's cost/memory analysis; zeros where a backend does not
+    report (attribution degrades, execution never does)."""
+    flops = nbytes = 0.0
+    peak = 0
+    try:
+        ca = exe.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = max(float(ca.get("flops", 0.0)), 0.0)
+        nbytes = max(float(ca.get("bytes accessed", 0.0)), 0.0)
+    except Exception:  # noqa: BLE001 — backend-dependent surface
+        pass
+    try:
+        ma = exe.memory_analysis()
+        if ma is not None:
+            peak = int(getattr(ma, "argument_size_in_bytes", 0)
+                       + getattr(ma, "output_size_in_bytes", 0)
+                       + getattr(ma, "temp_size_in_bytes", 0)
+                       + getattr(ma, "generated_code_size_in_bytes", 0))
+    except Exception:  # noqa: BLE001
+        pass
+    return flops, nbytes, peak
+
+
+class _PlanExecutable:
+    """AOT compile cache for one (plan fingerprint, monitor flag):
+    explicit ``lower().compile()`` per input signature instead of jit's
+    implicit dispatch, so every compile event is observed exactly once —
+    counted, timed, and cost/memory-attributed — with no second
+    compilation to pay for the analysis.
+    """
+
+    MAX_SIGNATURES = 64  # >> the bucket-ladder rungs a table ever visits
+
+    __slots__ = ("stats", "diag_names", "monitor_names", "_run",
+                 "_execs", "_lock")
+
+    def __init__(self, plan: PlanNode, plan_key: str, with_monitor: bool):
+        self.stats = _stats_for(plan_key)
+        self.diag_names: list[str] = []     # filled at trace time
+        self.monitor_names: list[str] = []
+        diag_names = self.diag_names
+        monitor_names = self.monitor_names
+
+        @jax.jit
+        def run(tables):
+            with diag.collect() as entries:
+                if with_monitor:
+                    with diag.monitor_collect() as mons:
+                        out = _lower(plan, tables)
+                    monitor_names.clear()
+                    monitor_names.extend(n for n, _ in mons)
+                    mvals = [v for _, v in mons]
+                else:
+                    out = _lower(plan, tables)
+                    mvals = []
+            diag_names.clear()
+            diag_names.extend(n for n, _ in entries)
+            # fold the per-operator overflow lanes into ONE scalar on
+            # device: the per-execute host check reads a single value
+            # instead of syncing once per diagnostic lane (obcheck
+            # trace.host-sync)
+            import jax.numpy as jnp
+
+            total = jnp.zeros((), dtype=jnp.int64)
+            for _n, v in entries:
+                total = total + jnp.maximum(
+                    jnp.asarray(v, dtype=jnp.int64), 0)
+            return out, [v for _, v in entries], total, mvals
+
+        # only ever driven through .lower()/.compile(): the jit wrapper
+        # exists for the lowering machinery (and so obcheck keeps seeing
+        # `run` as a traced root), its dispatch cache stays empty
+        self._run = run
+        #: signature -> (compiled executable, flops, bytes, peak)
+        self._execs: dict[tuple, tuple] = {}
+        self._lock = threading.Lock()
+
+    def _compile(self, tables, sig):
+        t0 = time.perf_counter()
+        exe = self._run.lower(tables).compile()
+        dt = time.perf_counter() - t0
+        flops, nbytes, peak = _xla_analysis(exe)
+        st = self.stats
+        st.xla_traces += 1
+        st.last_compile_s = dt
+        st.flops = flops
+        st.bytes_accessed = nbytes
+        st.peak_memory = peak
+        qmetrics.inc("plan.compiles")
+        qmetrics.observe("plan.compile_s", dt)
+        qmetrics.inc("plan.flops_compiled", int(flops))
+        qmetrics.inc("plan.bytes_compiled", int(nbytes))
+        if len(self._execs) >= self.MAX_SIGNATURES:
+            self._execs.pop(next(iter(self._execs)))
+        entry = (exe, flops, nbytes, peak)
+        self._execs[sig] = entry
+        return entry
+
+    def call(self, tables):
+        """-> ((out, diag_vals, diag_total, mon_vals), compiled_now)."""
+        sig = _input_signature(tables)
+        entry = self._execs.get(sig)
+        compiled_now = False
+        if entry is None:
+            with self._lock:
+                entry = self._execs.get(sig)
+                if entry is None:
+                    entry = self._compile(tables, sig)
+                    compiled_now = True
+        exe, flops, nbytes, _peak = entry
+        qmetrics.inc("plan.flops_executed", int(flops))
+        qmetrics.inc("plan.bytes_executed", int(nbytes))
+        return exe(tables), compiled_now
+
+
 @functools.lru_cache(maxsize=256)
 def _compiled(plan_key, plan_holder, with_monitor=False):
-    plan = plan_holder.plan
-    diag_names: list[str] = []     # filled at trace time
-    monitor_names: list[str] = []
-    stats = _stats_for(plan_key)
-
-    @jax.jit
-    def run(tables):
-        # trace-time side effect: the body only executes when jit
-        # retraces (a new input shape/dtype/aux combination), so this
-        # counts exactly the compile events
-        stats.xla_traces += 1
-        with diag.collect() as entries:
-            if with_monitor:
-                with diag.monitor_collect() as mons:
-                    out = _lower(plan, tables)
-                monitor_names.clear()
-                monitor_names.extend(n for n, _ in mons)
-                mvals = [v for _, v in mons]
-            else:
-                out = _lower(plan, tables)
-                mvals = []
-        diag_names.clear()
-        diag_names.extend(n for n, _ in entries)
-        # fold the per-operator overflow lanes into ONE scalar on device:
-        # the per-execute host check reads a single value instead of
-        # syncing once per diagnostic lane (obcheck trace.host-sync)
-        import jax.numpy as jnp
-
-        total = jnp.zeros((), dtype=jnp.int64)
-        for _n, v in entries:
-            total = total + jnp.maximum(jnp.asarray(v, dtype=jnp.int64), 0)
-        return out, [v for _, v in entries], total, mvals
-
-    # the stats object rides along with the compiled entry: the closure
-    # above increments THIS object at trace time, so callers must count
-    # executions on the same one (a fresh _stats_for lookup could return
-    # a new entry after registry eviction and desync the counters)
-    return run, diag_names, monitor_names, stats
+    # the stats object rides along with the executable bundle: callers
+    # must count executions on the same one (a fresh _stats_for lookup
+    # could return a new entry after registry eviction and desync the
+    # counters)
+    return _PlanExecutable(plan_holder.plan, plan_key, with_monitor)
 
 
 class _PlanHolder:
@@ -375,24 +513,32 @@ def execute_plan(plan: PlanNode, tables: dict[str, Relation],
     key = plan.fingerprint()
     needed = referenced_tables(plan)
     with_monitor = monitor_out is not None
-    run, diag_names, monitor_names, stats = _compiled(
-        key, _PlanHolder(plan, key), with_monitor)
-    traces_before = stats.xla_traces
+    bundle = _compiled(key, _PlanHolder(plan, key), with_monitor)
+    stats = bundle.stats
+    diag_names = bundle.diag_names
+    monitor_names = bundle.monitor_names
+    root_op = type(plan).__name__
     # full-link trace: one HOST-side span per plan execution, closed at
     # the result boundary below (never inside the jit-traced `run` body)
     with qtrace.span("plan.execute", plan_hash=stats.plan_hash) as tsp:
         t0 = time.perf_counter()
-        out, diag_vals, diag_total, mon_vals = run(
-            {k: v for k, v in tables.items() if k in needed})
+        (out, diag_vals, diag_total, mon_vals), compiled_now = \
+            bundle.call({k: v for k, v in tables.items() if k in needed})
         stats.executions += 1
-        if stats.xla_traces > traces_before:
-            dt = time.perf_counter() - t0
-            stats.last_compile_s = dt
+        qmetrics.inc("plan.executions", op=root_op)
+        qmetrics.observe("plan.execute_s", time.perf_counter() - t0,
+                         op=root_op)
+        if compiled_now:
             tsp.tags["compiled"] = 1
-            # compile-vs-execute attribution: the traced call's wall
+            # compile-vs-execute attribution: the lower+compile wall
             # time IS the XLA trace+compile cost the shape-bucket
-            # policy amortizes (gv$plan_cache.last_compile_s)
-            qtrace.add_span("xla.compile", dt, plan_hash=stats.plan_hash)
+            # policy amortizes (gv$plan_cache.last_compile_s), now with
+            # the program's measured flops/bytes riding the span tags
+            qtrace.add_span("xla.compile", stats.last_compile_s,
+                            plan_hash=stats.plan_hash,
+                            flops=stats.flops,
+                            bytes_accessed=stats.bytes_accessed,
+                            peak_memory=stats.peak_memory)
         if with_monitor:
             # audited: opt-in plan-monitor collection materializes
             # per-op row counts; only with enable_sql_plan_monitor set

@@ -56,9 +56,21 @@ from oceanbase_tpu.exec.spill import partitioned_join_spilled
 from oceanbase_tpu.expr import ir
 from oceanbase_tpu.px.dist_ops import split_aggs
 from oceanbase_tpu.px.planner import NotDistributable, split_top
+from oceanbase_tpu.server import metrics as qmetrics
 from oceanbase_tpu.server import trace as qtrace
 from oceanbase_tpu.storage.tmpfile import TempFileStore
 from oceanbase_tpu.vector import Relation, from_numpy, to_numpy
+
+# spill-tier accounting (host side, recorded once per spilled query at
+# the result boundary — same place the spill.execute span closes)
+qmetrics.declare("spill.executions", "counter",
+                 "queries routed through the disk-spill tier")
+qmetrics.declare("spill.bytes", "counter",
+                 "bytes written to the temp-file store")
+qmetrics.declare("spill.rows", "counter",
+                 "rows that crossed the host/disk boundary")
+qmetrics.declare("spill.execute_s", "histogram",
+                 "spilled-query wall time", unit="s")
 
 OUT_CHUNK = 1 << 16
 
@@ -143,6 +155,9 @@ def execute_spilled(plan: pp.PlanNode, providers: dict, spill_dir: str,
         except NotImplementedError as e:
             raise NotDistributable(str(e)) from None
 
+    import time as _time
+
+    m0 = _time.monotonic()
     with TempFileStore(spill_dir) as store, \
             qtrace.span("spill.execute") as tsp:
         ctx = _Ctx(store, budget_rows, chunk_rows, providers,
@@ -181,6 +196,12 @@ def execute_spilled(plan: pp.PlanNode, providers: dict, spill_dir: str,
                         bytes=ctx.stats.bytes,
                         spilled_rows=ctx.stats.spilled_rows,
                         batches=ctx.stats.batches)
+        qmetrics.inc("spill.executions", kind=ctx.stats.kind)
+        qmetrics.inc("spill.bytes", ctx.stats.bytes, kind=ctx.stats.kind)
+        qmetrics.inc("spill.rows", ctx.stats.spilled_rows,
+                     kind=ctx.stats.kind)
+        qmetrics.observe("spill.execute_s", _time.monotonic() - m0,
+                         kind=ctx.stats.kind)
         return arrays, valids, dict(ctx.dtypes), ctx.stats
 
 

@@ -34,7 +34,14 @@ import threading
 import time
 from dataclasses import dataclass
 
+from oceanbase_tpu.server import metrics as qmetrics
+
 UP, SUSPECT, DOWN = "up", "suspect", "down"
+
+qmetrics.declare("health.transitions", "counter",
+                 "failure-detector state flips (label: to=<state>)")
+qmetrics.declare("health.breaker_opens", "counter",
+                 "peers leaving the 'up' state")
 
 
 @dataclass
@@ -132,6 +139,7 @@ class HealthMonitor:
                 st.state = UP
                 st.last_change_ts = time.monotonic()
                 st.last_transition_ts = time.time()
+                qmetrics.inc("health.transitions", to=UP)
 
     def record_failure(self, peer: int):
         fire = None
@@ -149,10 +157,12 @@ class HealthMonitor:
             if new != st.state:
                 if st.state == UP:
                     st.breaker_opens += 1
+                    qmetrics.inc("health.breaker_opens")
                 went_down = new == DOWN
                 st.state = new
                 st.last_change_ts = time.monotonic()
                 st.last_transition_ts = time.time()
+                qmetrics.inc("health.transitions", to=new)
                 if went_down and self.on_down is not None:
                     fire = self.on_down
         if fire is not None:

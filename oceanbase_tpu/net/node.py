@@ -119,6 +119,14 @@ class NodeServer:
         self.peer_addrs = dict(peers)
         self.config = Config(persist_path=(
             os.path.join(root, "config.json") if root else None))
+        # metrics plane on/off rides the config (ALTER SYSTEM SET
+        # enable_metrics — scripts/metrics_bench.py prices the toggle)
+        from oceanbase_tpu.server import metrics as _qmetrics
+
+        _qmetrics.set_enabled(bool(self.config["enable_metrics"]))
+        self.config.watch(
+            lambda k, v: _qmetrics.set_enabled(bool(v))
+            if k == "enable_metrics" else None)
         # per-process fault plane: every frame this node sends or
         # receives consults it (seeded — nemesis schedules replay)
         self.faults = FaultPlane(seed=int(self.config["fault_seed"]))
@@ -192,6 +200,7 @@ class NodeServer:
             "node.state": self._h_state,
             "cluster.health": self._h_health,
             "recovery.state": self._h_recovery,
+            "metrics.scrape": self._h_metrics,
             "fault.inject": self._h_fault_inject,
             "fault.clear": self._h_fault_clear,
             **self.rebuild.handlers(),
@@ -253,6 +262,18 @@ class NodeServer:
         gv$cluster_health)."""
         return {"node_id": self.node_id,
                 "peers": self.health.snapshot()}
+
+    def _h_metrics(self, format: str = "wire"):
+        """One node's metrics snapshot (the wire face of gv$sysstat /
+        gv$sysstat_histogram).  ``format="prom"`` returns Prometheus
+        text exposition instead of the mergeable wire body."""
+        from oceanbase_tpu.server import metrics as qmetrics
+
+        if format == "prom":
+            return {"node_id": self.node_id,
+                    "text": qmetrics.prom_text()}
+        return {"node_id": self.node_id,
+                "wire": qmetrics.wire_snapshot()}
 
     def _h_recovery(self):
         """Recovery progress (the wire face of gv$recovery): boot

@@ -40,10 +40,30 @@ from dataclasses import dataclass
 
 from oceanbase_tpu.net.codec import decode_msg, encode_msg
 from oceanbase_tpu.net.faults import FaultDrop, FaultReset
+from oceanbase_tpu.server import metrics as qmetrics
 from oceanbase_tpu.server import trace as qtrace
 
 _U32 = struct.Struct("<I")
 MAX_MSG = 1 << 30
+
+# per-verb wire accounting (host side, recorded at the call/reply
+# boundary — the cluster half of gv$sysstat; scripts/metrics_bench.py
+# reconciles rpc.bytes against gv$px_exchange)
+qmetrics.declare("rpc.calls", "counter",
+                 "client calls that returned a decoded reply", )
+qmetrics.declare("rpc.failures", "counter",
+                 "client calls that terminally failed")
+qmetrics.declare("rpc.bytes", "counter",
+                 "wire bytes (request+reply frames) of successful calls")
+qmetrics.declare("rpc.retries", "counter",
+                 "resend attempts (idempotent verbs only)")
+qmetrics.declare("rpc.deadline_exceeded", "counter",
+                 "calls that died at the verb policy's deadline")
+qmetrics.declare("rpc.call_s", "histogram",
+                 "per-attempt round-trip latency of successful calls",
+                 unit="s")
+qmetrics.declare("rpc.served", "counter",
+                 "server-side handler invocations")
 
 
 class RpcError(RuntimeError):
@@ -116,6 +136,9 @@ POLICIES: dict[str, VerbPolicy] = {
     # so a wiped node's bootstrap survives transient drops
     "rebuild.fetch_meta":     VerbPolicy(120.0, True, 2, 0.10, 1.00),
     "rebuild.fetch_segments": VerbPolicy(60.0, True, 3, 0.05, 1.00),
+    # metrics.scrape is a pure read of monotonic counters — re-asking
+    # returns a superset-or-equal snapshot, trivially idempotent
+    "metrics.scrape": VerbPolicy(5.0, True, 2, 0.02, 0.20),
     "sql.execute":  VerbPolicy(600.0, False),
 }
 
@@ -219,10 +242,12 @@ class _Handler(socketserver.BaseRequestHandler):
                         with qtrace.span(str(verb), src=src):
                             result = fn(**(msg.get("params") or {}))
                     resp = {"rid": rid, "ok": True, "result": result}
+                    qmetrics.inc("rpc.served", verb=str(verb), ok=1)
                 except Exception as e:  # noqa: BLE001 — ship to caller
                     resp = {"rid": rid, "ok": False,
                             "error_kind": type(e).__name__,
                             "error": str(e)}
+                    qmetrics.inc("rpc.served", verb=str(verb), ok=0)
                 if tctx is not None and tctx.spans:
                     resp["spans"] = [s.to_wire()
                                      for s in tctx.snapshot()]
@@ -405,10 +430,14 @@ class RpcClient:
                     raise ProtocolError(f"undecodable reply: {e}") from e
                 self._checkin(conn)
                 conn = None
+                rtt = time.monotonic() - a0
                 if obs is not None:
-                    obs.record_success(time.monotonic() - a0)
+                    obs.record_success(rtt)
                 sent = len(req) + 4
                 recv = len(frame) + 4
+                qmetrics.inc("rpc.calls", verb=method)
+                qmetrics.inc("rpc.bytes", sent + recv, verb=method)
+                qmetrics.observe("rpc.call_s", rtt, verb=method)
                 if tspan is not None:
                     tspan.tags["retries"] = attempt
                     tspan.tags["bytes"] = sent + recv
@@ -447,18 +476,29 @@ class RpcClient:
                 # verbs may be resent (the reply may be the lost frame)
                 may_retry = (not sent_ok) or pol.idempotent
                 if not may_retry or attempt >= max(pol.max_retries, 1):
+                    self._count_terminal(method, e, now, deadline)
                     raise self._at_deadline(e, method, now, deadline,
                                             deadline_s)
                 backoff = min(pol.backoff_base_s * (2 ** attempt),
                               pol.backoff_cap_s)
                 backoff *= 0.5 + random.random()  # full jitter
                 if now + backoff >= deadline:
+                    self._count_terminal(method, e, now, deadline)
                     raise self._at_deadline(e, method, now, deadline,
                                             deadline_s)
                 time.sleep(backoff)
                 attempt += 1
+                qmetrics.inc("rpc.retries", verb=method)
                 if obs is not None:
                     obs.record_retry()
+
+    @staticmethod
+    def _count_terminal(method: str, e: Exception, now: float,
+                        deadline: float):
+        qmetrics.inc("rpc.failures", verb=method)
+        if isinstance(e, (socket.timeout, DeadlineExceeded)) \
+                or now >= deadline:
+            qmetrics.inc("rpc.deadline_exceeded", verb=method)
 
     def _at_deadline(self, e: Exception, method: str, now: float,
                      deadline: float, deadline_s: float) -> Exception:

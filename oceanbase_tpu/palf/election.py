@@ -19,6 +19,13 @@ import threading
 import time
 from dataclasses import dataclass
 
+from oceanbase_tpu.server import metrics as qmetrics
+
+qmetrics.declare("palf.elections", "counter",
+                 "election campaigns started on this node")
+qmetrics.declare("palf.elections_won", "counter",
+                 "campaigns that reached quorum")
+
 
 @dataclass
 class VoteRequest:
@@ -75,6 +82,7 @@ class ElectionProposer:
         return (self.lease_ms + random.randint(0, self.lease_ms)) / 1000.0
 
     def campaign(self, peer_ids) -> bool:
+        qmetrics.inc("palf.elections")
         r = self.replica
         r.current_term += 1
         term = r.current_term
@@ -97,6 +105,7 @@ class ElectionProposer:
         if votes >= quorum and r.current_term == term:
             r.role = "leader"
             self.refresh_lease()
+            qmetrics.inc("palf.elections_won")
             return True
         r.role = "follower"
         return False

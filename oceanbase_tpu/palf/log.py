@@ -20,12 +20,26 @@ import logging
 import os
 import struct
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from oceanbase_tpu.native import crc64
+from oceanbase_tpu.server import metrics as qmetrics
 
 log = logging.getLogger(__name__)
+
+# replication-plane accounting (host side; server/metrics.py registry)
+qmetrics.declare("palf.appends", "counter",
+                 "leader group-append batches")
+qmetrics.declare("palf.entries_appended", "counter",
+                 "log entries appended on the leader")
+qmetrics.declare("palf.fsyncs", "counter",
+                 "durable log fsyncs (append path)")
+qmetrics.declare("palf.fsync_s", "histogram",
+                 "append-path fsync latency", unit="s")
+qmetrics.declare("palf.entries_applied", "counter",
+                 "committed entries applied through the state machine")
 
 _HDR = struct.Struct("<QQIQ")  # term, lsn(index), payload_len, crc64
 _MAGIC = b"OBTPULG1"  # file magic + format version (bump on layout change)
@@ -88,8 +102,11 @@ class PalfReplica:
                 self._log_f.write(_MAGIC)
         for e in entries:
             self._log_f.write(e.encode())
+        t0 = time.perf_counter()
         self._log_f.flush()
         os.fsync(self._log_f.fileno())
+        qmetrics.inc("palf.fsyncs")
+        qmetrics.observe("palf.fsync_s", time.perf_counter() - t0)
 
     def _truncate_disk(self):
         """Rewrite the on-disk log after a suffix truncation."""
@@ -168,6 +185,8 @@ class PalfReplica:
                 self.entries.append(e)
                 out.append(e)
             self._persist(out)
+            qmetrics.inc("palf.appends")
+            qmetrics.inc("palf.entries_appended", len(out))
             return out
 
     def last_lsn(self) -> int:
@@ -247,6 +266,7 @@ class PalfReplica:
                     e = self.entries[self.applied_lsn]
                 if self.apply_cb is not None:
                     self.apply_cb(e)
+                qmetrics.inc("palf.entries_applied")
                 with self._lock:
                     self.applied_lsn += 1
         finally:

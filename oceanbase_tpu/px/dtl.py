@@ -42,8 +42,26 @@ from oceanbase_tpu.exec.ops import AggSpec
 from oceanbase_tpu.exec.plan import execute_plan
 from oceanbase_tpu.expr import ir
 from oceanbase_tpu.px.dist_ops import split_aggs
+from oceanbase_tpu.server import metrics as qmetrics
 from oceanbase_tpu.server import trace as qtrace
 from oceanbase_tpu.vector import Relation, from_numpy, to_numpy
+
+# exchange accounting (host side, recorded at DtlMetrics.record — the
+# same result boundary the gv$px_exchange ring observes)
+qmetrics.declare("dtl.exchanges", "counter",
+                 "exchange events (pushdown fan-outs + legacy pulls)")
+qmetrics.declare("dtl.bytes_shipped", "counter",
+                 "wire bytes moved by the exchange")
+qmetrics.declare("dtl.rows_shipped", "counter",
+                 "exchange rows crossing the wire")
+qmetrics.declare("dtl.slices", "counter",
+                 "partial-plan slices executed (local + remote)")
+qmetrics.declare("dtl.fallback_parts", "counter",
+                 "slices re-run locally AFTER a peer failure")
+qmetrics.declare("dtl.avoided_parts", "counter",
+                 "slices routed locally pre-emptively (unhealthy peer)")
+qmetrics.declare("dtl.exchange_s", "histogram",
+                 "whole-exchange wall time", unit="s")
 
 #: name of the coordinator-side relation holding the merged exchange rows
 DTL_TABLE = "__dtl_recv__"
@@ -522,6 +540,15 @@ class DtlMetrics:
                 self.pushdown_hits += 1
             else:
                 self.pulls += 1
+        qmetrics.inc("dtl.exchanges", mode=rec.mode)
+        qmetrics.inc("dtl.bytes_shipped", rec.bytes_shipped, mode=rec.mode)
+        qmetrics.inc("dtl.rows_shipped", rec.rows_shipped, mode=rec.mode)
+        qmetrics.inc("dtl.slices", rec.parts, mode=rec.mode)
+        if rec.fallback_parts:
+            qmetrics.inc("dtl.fallback_parts", rec.fallback_parts)
+        if rec.avoided_parts:
+            qmetrics.inc("dtl.avoided_parts", rec.avoided_parts)
+        qmetrics.observe("dtl.exchange_s", rec.elapsed_s, mode=rec.mode)
 
     def recent(self, n: int = 100) -> list:
         with self._lock:

@@ -173,6 +173,12 @@ DEF("enable_rate_limit", False, "bool",
     "throttle writes on memstore pressure (≙ write throttling)")
 
 # diagnostics
+DEF("enable_metrics", True, "bool",
+    "cluster-wide metrics plane (server/metrics.py): named counters, "
+    "gauges and log-bucketed latency histograms updated host-side at "
+    "result/span-close boundaries, surfaced as gv$sysstat / "
+    "gv$sysstat_histogram / SHOW METRICS and scraped cluster-wide over "
+    "the metrics.scrape verb (≙ ob_diagnose_info sysstat counters)")
 DEF("enable_query_trace", True, "bool",
     "full-link statement tracing (server/trace.py): a root span per "
     "statement, children across compile/execute/spill/exchange/rpc, "

@@ -44,6 +44,15 @@ class Database:
         self._session_ids = itertools.count(1)
         self.node_id = 0  # single-process instance (NodeDatabase overrides)
 
+        # metrics plane on/off rides the config (ALTER SYSTEM SET
+        # enable_metrics; scripts/metrics_bench.py prices the toggle)
+        from oceanbase_tpu.server import metrics as qmetrics
+
+        qmetrics.set_enabled(bool(self.config["enable_metrics"]))
+        self.config.watch(
+            lambda k, v: qmetrics.set_enabled(bool(v))
+            if k == "enable_metrics" else None)
+
         # observability (cluster-wide)
         self.audit = SqlAudit(int(self.config["sql_audit_queue_size"]))
         self.plan_monitor = PlanMonitor()

@@ -88,22 +88,40 @@ class PlanMonitor:
 
 
 class WaitEvents:
-    """Named counters/timers (≙ wait-event instrumentation)."""
+    """Named wait-event timers (≙ wait-event instrumentation).
+
+    Backed by the shared log-bucketed histogram type
+    (server/metrics.py::Histogram) instead of bare count+sum, so
+    gv$system_event serves min/max/p95/p99 per event.  ``snapshot()``
+    keeps the legacy (count, total_seconds) tuple shape wire-compatible;
+    ``stats()`` is the full distribution."""
 
     def __init__(self):
-        self._counts: collections.Counter = collections.Counter()
-        self._times: collections.defaultdict = collections.defaultdict(float)
+        from oceanbase_tpu.server.metrics import Histogram
+
+        self._hist_cls = Histogram
+        self._hists: dict = {}
         self._lock = threading.Lock()
 
     def add(self, event: str, seconds: float = 0.0):
         with self._lock:
-            self._counts[event] += 1
-            self._times[event] += seconds
+            h = self._hists.get(event)
+            if h is None:
+                h = self._hists[event] = self._hist_cls()
+            h.observe(seconds)
 
     def snapshot(self) -> dict:
+        """Legacy shape: {event: (count, total_seconds)}."""
         with self._lock:
-            return {e: (self._counts[e], self._times[e])
-                    for e in self._counts}
+            return {e: (h.count, h.sum) for e, h in self._hists.items()}
+
+    def stats(self) -> dict:
+        """{event: {count, sum, min, max, p50, p95, p99}} — the
+        gv$system_event row shape."""
+        from oceanbase_tpu.server.metrics import hist_stats
+
+        with self._lock:
+            return {e: hist_stats(h) for e, h in self._hists.items()}
 
 
 class AshSampler:

@@ -38,6 +38,9 @@ class VirtualTables:
             "gv$trace": self.trace,
             "gv$active_session_history": self.active_session_history,
             "gv$system_event": self.wait_events,
+            "gv$sysstat": self.sysstat,
+            "gv$sysstat_histogram": self.sysstat_histogram,
+            "gv$memory": self.memory,
             "v$session_history": self.session_history,
             "v$parameters": self.parameters,
             "v$tenants": self.tenants,
@@ -144,6 +147,15 @@ class VirtualTables:
                                         np.int64),
             "last_compile_s": np.array([e.last_compile_s
                                         for e in entries], np.float64),
+            # XLA cost/memory attribution of the last compiled
+            # signature (exec/plan.py::_xla_analysis): the measured
+            # flops / bytes-accessed / peak bytes the cost-based
+            # optimizer arc prices against
+            "flops": np.array([e.flops for e in entries], np.float64),
+            "bytes_accessed": np.array([e.bytes_accessed
+                                        for e in entries], np.float64),
+            "peak_memory": np.array([e.peak_memory for e in entries],
+                                    np.int64),
             "created_ts": np.array([e.created_ts for e in entries],
                                    np.float64),
         }
@@ -378,13 +390,155 @@ class VirtualTables:
         }
 
     def wait_events(self):
+        """Wait-event distributions (≙ gv$system_event): the legacy
+        total_waits/time_waited_s columns stay wire-compatible; the
+        histogram upgrade adds min/max/p95/p99 per event."""
         we = getattr(self.db, "wait_events", None)
-        snap = we.snapshot() if we is not None else {}
+        stats = we.stats() if we is not None \
+            and hasattr(we, "stats") else {}
+        events = sorted(stats)
         return {
-            "event": _obj(snap.keys()),
-            "total_waits": np.array([c for c, _ in snap.values()], np.int64),
-            "time_waited_s": np.array([t for _, t in snap.values()],
+            "event": _obj(events),
+            "total_waits": np.array([stats[e]["count"] for e in events],
+                                    np.int64),
+            "time_waited_s": np.array([stats[e]["sum"] for e in events],
                                       np.float64),
+            "min_wait_s": np.array([stats[e]["min"] for e in events],
+                                   np.float64),
+            "max_wait_s": np.array([stats[e]["max"] for e in events],
+                                   np.float64),
+            "p50_s": np.array([stats[e]["p50"] for e in events],
+                              np.float64),
+            "p95_s": np.array([stats[e]["p95"] for e in events],
+                              np.float64),
+            "p99_s": np.array([stats[e]["p99"] for e in events],
+                              np.float64),
+        }
+
+    # ------------------------------------------------------------------
+    # metrics plane (server/metrics.py): cluster-wide scrape + surfaces
+    # ------------------------------------------------------------------
+    def scrape_cluster(self) -> dict:
+        """Cluster-merged scrape body: this process's registry plus every
+        reachable peer's over the idempotent ``metrics.scrape`` verb
+        (unreachable peers degrade the view, never the query) — the gv$
+        prefix's promise."""
+        from oceanbase_tpu.server import metrics as qmetrics
+
+        wire = qmetrics.wire_snapshot()
+        node = getattr(self.db, "_node", None)
+        peers = getattr(node, "peers", None) if node is not None else None
+        if peers:
+            health = getattr(node, "health", None)
+            for pid in sorted(peers):
+                # a peer the failure detector already declared DOWN
+                # would stall the read for the verb deadline — skip it
+                # (the same pre-emptive avoidance DTL routing applies)
+                if health is not None and health.state(pid) == "down":
+                    continue
+                try:
+                    r = peers[pid].call("metrics.scrape",
+                                        _deadline_s=2.0)
+                    wire = qmetrics.merge_wire(wire, r["wire"])
+                except Exception:  # noqa: BLE001 — degraded view
+                    continue
+        return wire
+
+    def sysstat(self):
+        """Cluster-wide counters + gauges (≙ gv$sysstat): one row per
+        series, labels rendered into the stat name
+        (``rpc.bytes{verb=dtl.execute}``) and as a JSON column."""
+        import json as _json
+
+        from oceanbase_tpu.server import metrics as qmetrics
+
+        wire = self.scrape_cluster()
+        rows = []
+        for kind in ("counters", "gauges"):
+            for n, lbl, v in wire.get(kind, []):
+                rows.append((qmetrics.series_id(n, lbl), n,
+                             _json.dumps(lbl, sort_keys=True)
+                             if lbl else "", kind[:-1], float(v)))
+        return {
+            "stat_name": _obj(r[0] for r in rows),
+            "name": _obj(r[1] for r in rows),
+            "labels": _obj(r[2] for r in rows),
+            "stat_type": _obj(r[3] for r in rows),
+            "value": np.array([r[4] for r in rows], np.float64),
+        }
+
+    def sysstat_histogram(self):
+        """Cluster-wide latency distributions (≙ the sysstat histogram
+        views): p50/p95/p99 computed from merged log-bucket counts —
+        never from stored samples."""
+        import json as _json
+
+        from oceanbase_tpu.server import metrics as qmetrics
+
+        wire = self.scrape_cluster()
+        rows = []
+        for n, lbl, hw in wire.get("hists", []):
+            h = qmetrics.Histogram.from_wire(hw)
+            st = qmetrics.hist_stats(h)
+            rows.append((qmetrics.series_id(n, lbl), n,
+                         _json.dumps(lbl, sort_keys=True) if lbl else "",
+                         st))
+        return {
+            "stat_name": _obj(r[0] for r in rows),
+            "name": _obj(r[1] for r in rows),
+            "labels": _obj(r[2] for r in rows),
+            "count": np.array([r[3]["count"] for r in rows], np.int64),
+            "sum_s": np.array([r[3]["sum"] for r in rows], np.float64),
+            "min_s": np.array([r[3]["min"] for r in rows], np.float64),
+            "max_s": np.array([r[3]["max"] for r in rows], np.float64),
+            "p50_s": np.array([r[3]["p50"] for r in rows], np.float64),
+            "p95_s": np.array([r[3]["p95"] for r in rows], np.float64),
+            "p99_s": np.array([r[3]["p99"] for r in rows], np.float64),
+        }
+
+    def memory(self):
+        """Device-memory attribution per table (≙ gv$memory): the
+        bucket-padded buffer footprint vs the live-row footprint, and
+        the pad-waste the shape-bucket ladder is paying for executable
+        reuse.  Capacity mirrors the materialization policy
+        (StorageCatalog._bucket_policy), so ALTER SYSTEM SET
+        shape_bucket_growth moves the ratio immediately."""
+        from oceanbase_tpu.datatypes import TypeKind
+        from oceanbase_tpu.vector.column import bucket_capacity
+
+        rows = []
+        for tname, tenant in self.db.tenants.items():
+            cat = tenant.catalog
+            enabled, floor, growth = cat._bucket_policy()
+            for name, ts in tenant.engine.tables.items():
+                live = int(ts.tablet.row_count_estimate())
+                cap = (bucket_capacity(max(live, 1), floor, growth)
+                       if enabled else max(live, 1))
+                # per-row device bytes: payload width (string columns
+                # carry int32 dictionary codes) + validity + mask lanes
+                row_bytes = 1  # the relation mask
+                for c in ts.tdef.columns:
+                    w = np.dtype(c.dtype.np_dtype).itemsize
+                    if c.dtype.kind == TypeKind.VECTOR:
+                        w *= max(int(c.dtype.precision or 1), 1)
+                    row_bytes += int(w)
+                    if c.nullable:
+                        row_bytes += 1
+                live_b = live * row_bytes
+                buf_b = cap * row_bytes
+                waste = 1.0 - (live / cap) if cap else 0.0
+                rows.append((tname, name, live, cap, row_bytes,
+                             live_b, buf_b, waste))
+        return {
+            "tenant": _obj(r[0] for r in rows),
+            "table_name": _obj(r[1] for r in rows),
+            "live_rows": np.array([r[2] for r in rows], np.int64),
+            "buffer_capacity": np.array([r[3] for r in rows], np.int64),
+            "row_bytes": np.array([r[4] for r in rows], np.int64),
+            "live_bytes": np.array([r[5] for r in rows], np.int64),
+            "buffer_bytes": np.array([r[6] for r in rows], np.int64),
+            "pad_waste_ratio": np.array([r[7] for r in rows],
+                                        np.float64),
         }
 
     def kvcache(self):

@@ -225,6 +225,8 @@ class Parser:
                 return ast.ShowStmt("processlist")
             if self._accept_word("trace"):
                 return ast.ShowStmt("trace")
+            if self._accept_word("metrics"):
+                return ast.ShowStmt("metrics")
             self.expect_kw("tables")
             return ast.ShowTablesStmt()
         if self.at_kw("describe"):

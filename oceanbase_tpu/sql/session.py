@@ -23,11 +23,27 @@ from oceanbase_tpu.exec.diag import CapacityOverflow
 from oceanbase_tpu.exec.plan import execute_plan
 from oceanbase_tpu.expr import ir
 from oceanbase_tpu.expr.compile import literal_value
+from oceanbase_tpu.server import metrics as qmetrics
 from oceanbase_tpu.sql import ast
 from oceanbase_tpu.sql.binder import Binder
 from oceanbase_tpu.sql.optimizer import scale_capacities
 from oceanbase_tpu.sql.parser import parse_sql
 from oceanbase_tpu.vector import Relation, from_numpy, to_numpy
+
+# serving-plane statement accounting (host side, statement boundary —
+# the latency distribution the p50/p99 serving arc is gated on)
+qmetrics.declare("sql.statements", "counter",
+                 "statements executed (labels: tenant, ok)")
+qmetrics.declare("sql.statement_s", "histogram",
+                 "end-to-end statement latency", unit="s")
+qmetrics.declare("sql.rows_returned", "counter",
+                 "result rows returned to clients")
+qmetrics.declare("plan_cache.hits", "counter",
+                 "session plan-cache hits")
+qmetrics.declare("plan_cache.misses", "counter",
+                 "session plan-cache misses (bind + optimize paid)")
+qmetrics.declare("plan_cache.evictions", "counter",
+                 "session plan-cache LRU evictions")
 
 _POW10 = [10**i for i in range(38)]
 
@@ -155,6 +171,13 @@ class Session:
             elapsed = time.monotonic() - t0
             self._ash_state.update(active=False, state="idle",
                                    trace_id="")
+            tname = getattr(self.tenant, "name", "sys")
+            qmetrics.inc("sql.statements", tenant=tname,
+                         ok=0 if err else 1)
+            qmetrics.observe("sql.statement_s", elapsed, tenant=tname)
+            if out is not None and out.rowcount > 0:
+                qmetrics.inc("sql.rows_returned", out.rowcount,
+                             tenant=tname)
             trace_id = ""
             if tctx is not None:
                 kept = qtrace.finish_trace(self.db, tctx, elapsed,
@@ -453,6 +476,8 @@ class Session:
                     {}, {}, rowcount=len(names))
             if stmt.what == "trace":
                 return self._show_trace()
+            if stmt.what == "metrics":
+                return self._show_metrics()
             if stmt.what == "processlist":
                 rows = []
                 if self.db is not None and \
@@ -819,6 +844,20 @@ class Session:
              "key": np.array([""] * len(names), dtype=object)},
             {}, {}, rowcount=len(names))
 
+    def _show_metrics(self) -> Result:
+        """SHOW METRICS: the cluster-merged scrape rendered as
+        Prometheus text exposition, one line per row (the same dump
+        ``metrics.scrape(format="prom")`` serves over the wire)."""
+        vt = getattr(self.db, "virtual_tables", None) \
+            if self.db is not None else None
+        wire = vt.scrape_cluster() if vt is not None \
+            else qmetrics.wire_snapshot()
+        lines = qmetrics.prom_text(wire).splitlines()
+        return Result(
+            ["metric"],
+            {"metric": np.array(lines, dtype=object)},
+            {}, {"metric": SqlType.string()}, rowcount=len(lines))
+
     def _show_trace(self) -> Result:
         """SHOW TRACE: the last kept statement trace rendered as an
         indented span tree (≙ SHOW TRACE over the flt span store).
@@ -892,7 +931,9 @@ class Session:
         hit = self.plan_cache.get(key)
         if hit is not None:
             self.plan_cache.move_to_end(key)  # LRU touch
+            qmetrics.inc("plan_cache.hits")
             return hit
+        qmetrics.inc("plan_cache.misses")
         seqs = self.tenant.sequences if self.tenant is not None else None
         binder = Binder(self.catalog, params=params or [], sequences=seqs,
                         sysvars=self.variables)
@@ -935,6 +976,7 @@ class Session:
                 or len(self.plan_cache) > self._PLAN_CACHE_MAX_ENTRIES):
             k, _ = self.plan_cache.popitem(last=False)
             self._plan_cache_total -= self._plan_cache_bytes.pop(k, 0)
+            qmetrics.inc("plan_cache.evictions")
 
     def _table_snapshot(self, name: str):
         """Read a table at the right snapshot: an active transaction sees

@@ -26,7 +26,16 @@ import threading
 import time
 from collections import deque
 
+from oceanbase_tpu.server import metrics as qmetrics
+
 MAX_EVENTS = 256
+
+qmetrics.declare("recovery.events", "counter",
+                 "recovery-plane events (label: phase)")
+qmetrics.declare("recovery.entries", "counter",
+                 "WAL entries replayed/shipped by recovery events")
+qmetrics.declare("recovery.bytes", "counter",
+                 "bytes moved by recovery events (rebuild fetches)")
 
 
 class RecoveryState:
@@ -50,6 +59,11 @@ class RecoveryState:
               "elapsed_s": float(elapsed_s), "note": note}
         with self._lock:
             self._events.append(ev)
+        qmetrics.inc("recovery.events", phase=phase)
+        if entries:
+            qmetrics.inc("recovery.entries", int(entries), phase=phase)
+        if nbytes:
+            qmetrics.inc("recovery.bytes", int(nbytes), phase=phase)
         return ev
 
     def rows(self) -> list[dict]:

@@ -457,6 +457,16 @@ def main():
                                 for s in out["scenarios"].values())
         out["hung_total"] = sum(s["hung"]
                                 for s in out["scenarios"].values())
+        # bench artifacts and the metrics plane share one schema: embed
+        # the coordinator's gv$sysstat snapshot (rpc retry/deadline and
+        # health transition counters tell the nemesis story in numbers)
+        from oceanbase_tpu.server import metrics as qmetrics
+
+        try:
+            out["sysstat"] = qmetrics.wire_to_flat(
+                c1.call("metrics.scrape")["wire"])
+        except Exception as e:  # noqa: BLE001 — artifact, not gate
+            out["sysstat"] = {"error": str(e)}
         print(json.dumps(out))
     finally:
         for p in procs.values():

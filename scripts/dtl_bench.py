@@ -166,6 +166,13 @@ def main():
         pbytes, prow = pull_bytes(c1, "lineitem")
         pull_s = time.time() - t0
 
+        # bench artifacts and the metrics plane share one schema: embed
+        # the coordinator's gv$sysstat snapshot (flat {series: value})
+        from oceanbase_tpu.server import metrics as qmetrics
+
+        sysstat = qmetrics.wire_to_flat(
+            c1.call("metrics.scrape")["wire"])
+
         print(json.dumps({
             "metric": "dtl_bytes_on_wire",
             "query": query, "rows": n_rows,
@@ -177,6 +184,7 @@ def main():
             "pull_s": round(pull_s, 4),
             "bytes_ratio": round(ex["bytes_shipped"] / max(pbytes, 1), 6),
             "load_s": round(t_load, 2),
+            "sysstat": sysstat,
         }))
     finally:
         for p in procs:

@@ -1,21 +1,22 @@
 #!/usr/bin/env python
-"""Trace bench: full-link tracing overhead + fault attribution.
+"""Metrics bench: collection overhead + cluster-scrape reconciliation.
 
-Two halves, one JSON line:
+Two halves, one JSON line (the metrics plane's standing contract):
 
 1. **Overhead** — the TPC-H slice (q6 + q1) on an in-process Database,
-   timed with tracing OFF (``enable_query_trace=false``) vs ON at
-   ``trace_sample_rate=1.0``.  Every statement collects its full span
-   tree in the ON runs; the contract is <= 2% elapsed overhead.
+   timed with metrics OFF (``enable_metrics=false``) vs ON.  Every
+   statement updates the statement/plan/plan-cache series in the ON
+   runs; the contract is <= 2% elapsed overhead.
 
-2. **Attribution** — a real 3-node cluster runs Q6 through the DTL
-   exchange with an injected ``fault.inject`` delay on ``dtl.execute``
-   toward one peer.  The query's gv$sql_audit row must join one
-   gv$trace tree by trace_id whose SLOWEST span is the injected verb
-   (``rpc.dtl.execute``) toward the injected peer.
+2. **Scrape reconciliation** — a real 3-node cluster runs Q6 through
+   the DTL exchange, then every node is scraped over the idempotent
+   ``metrics.scrape`` verb and the merged per-verb ``rpc.bytes``
+   counter for ``dtl.execute`` must reconcile with the coordinator's
+   ``gv$px_exchange`` pushdown bytes within 1% — the cluster-wide
+   counters and the exchange ring are two views of one wire.
 
-    python scripts/trace_bench.py                 # both halves
-    TRACE_BENCH_SKIP_CLUSTER=1 python scripts/trace_bench.py
+    python scripts/metrics_bench.py                  # both halves
+    METRICS_BENCH_SKIP_CLUSTER=1 python scripts/metrics_bench.py
 """
 
 from __future__ import annotations
@@ -83,56 +84,59 @@ def _time_queries(sess, repeats: int) -> float:
 
 def bench_overhead(n_rows: int, repeats: int) -> dict:
     from oceanbase_tpu.server import Database
+    from oceanbase_tpu.server import metrics as qmetrics
 
-    root = tempfile.mkdtemp(prefix="tracebench_")
+    root = tempfile.mkdtemp(prefix="metricsbench_")
     try:
         db = Database(root)
         s = db.session()
         _load(s, _gen(n_rows), n_rows)
-        # parity guard: tracing must never change results
-        s.execute("alter system set enable_query_trace = true")
+        # parity guard: metrics must never change results
+        s.execute("alter system set enable_metrics = true")
         on_rows = {k: s.execute(q).rows() for k, q in QUERIES.items()}
-        s.execute("alter system set enable_query_trace = false")
+        s.execute("alter system set enable_metrics = false")
         off_rows = {k: s.execute(q).rows() for k, q in QUERIES.items()}
-        assert on_rows == off_rows, "tracing changed results"
+        assert on_rows == off_rows, "metrics changed results"
         # warm the jit caches so the measurement sees steady state
         _time_queries(s, 3)
         # interleave off/on blocks in ALTERNATING order so warmth and
         # drift hit both sides equally
-        s.execute("alter system set trace_sample_rate = 1.0")
         off_s = on_s = 0.0
         blocks = 4
         per_block = max(repeats // blocks, 1)
         for b in range(blocks):
             order = ("false", "true") if b % 2 == 0 else ("true", "false")
             for mode in order:
-                s.execute(f"alter system set enable_query_trace = {mode}")
+                s.execute(f"alter system set enable_metrics = {mode}")
                 dt = _time_queries(s, per_block)
                 if mode == "true":
                     on_s += dt
                 else:
                     off_s += dt
-        n_spans = len(db.trace_registry.recent(100000))
+        s.execute("alter system set enable_metrics = true")
+        n_series = len(qmetrics.sysstat_dict())
         db.close()
         return {
             "rows": n_rows, "repeats": per_block * blocks,
             "off_s": round(off_s, 4), "on_s": round(on_s, 4),
             "overhead_pct": round((on_s - off_s) / off_s * 100.0, 3),
-            "spans_in_ring": n_spans,
+            "series": n_series,
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)
 
 
-def bench_attribution(n_rows: int, seed: int = 7) -> dict:
-    """3-node cluster, delay injected on dtl.execute toward peer 2: the
-    slowest span of Q6's trace must name the verb and the peer."""
+def bench_scrape(n_rows: int, seed: int = 7) -> dict:
+    """3-node cluster: scrape every node, merge, reconcile the merged
+    rpc.bytes{verb=dtl.execute} against gv$px_exchange pushdown bytes."""
     from chaos_bench import boot_cluster, rows_of, wait_converged
 
-    root = tempfile.mkdtemp(prefix="tracebench_cl_")
+    from oceanbase_tpu.server import metrics as qmetrics
+
+    root = tempfile.mkdtemp(prefix="metricsbench_cl_")
     procs = {}
     try:
-        procs, clients, _start_node, _wc = boot_cluster(root, seed=seed)
+        procs, clients, _sn, _wc = boot_cluster(root, seed=seed)
         c1 = clients[1]
 
         def sql(text):
@@ -161,44 +165,46 @@ def bench_attribution(n_rows: int, seed: int = 7) -> dict:
             sql(f"insert into lineitem values {vals}")
         wait_converged(clients, "lineitem", n_rows)
         sql("alter system set dtl_min_rows = 1")
-        baseline = rows_of(sql(QUERIES["q6"]))
-        sql(QUERIES["q6"])  # warm the pushdown path
+        for _ in range(3):
+            sql(QUERIES["q6"])  # pushdown traffic to reconcile
 
-        delay_ms = 400.0
-        c1.call("fault.inject", where="send", action="delay",
-                verb="dtl.execute", peer=2, delay_ms=delay_ms)
-        t0 = time.monotonic()
-        faulted = rows_of(sql(QUERIES["q6"]))
-        q6_s = time.monotonic() - t0
-        c1.call("fault.clear")
-        assert faulted == baseline, "fault changed results"
+        # scrape all three nodes and merge (exactly what gv$sysstat does)
+        merged: dict = {"counters": [], "gauges": [], "hists": []}
+        per_node = {}
+        for i, cli in sorted(clients.items()):
+            r = cli.call("metrics.scrape")
+            flat = qmetrics.wire_to_flat(r["wire"])
+            per_node[str(i)] = {
+                k: v for k, v in flat.items() if k.startswith("rpc.")}
+            merged = qmetrics.merge_wire(merged, r["wire"])
+        flat = qmetrics.wire_to_flat(merged)
+        rpc_dtl_bytes = flat.get("rpc.bytes{verb=dtl.execute}", 0)
 
-        # join the audit row to its trace by trace_id
-        audit = rows_of(sql(
-            "select trace_id, sql, start_ts from gv$sql_audit"))
-        trace_id = next(
-            tid for tid, q, _ts in sorted(audit, key=lambda r: -r[2])
-            if tid and q.startswith("select sum(l_extendedprice"))
-        spans = rows_of(sql(
-            f"select span_name, node, elapsed_s, tags from gv$trace"
-            f" where trace_id = '{trace_id}'"
-            f" order by elapsed_s desc"))
-        # the root/statement/execute chain contains the delay too; the
-        # slowest LEAF-side span below them must be the injected verb
-        chain = {"statement", "execute", "dtl.exchange", "dtl.slice"}
-        slowest = next(s for s in spans if s[0] not in chain)
-        tags = json.loads(slowest[3]) if slowest[3] else {}
-        ok = (slowest[0] == "rpc.dtl.execute"
-              and int(tags.get("peer", -1)) == 2
-              and float(slowest[2]) >= delay_ms / 1000.0)
+        # the exchange ring's view of the same wire (coordinator-side)
+        exch = rows_of(sql(
+            "select bytes_shipped from gv$px_exchange"
+            " where mode = 'pushdown'"))
+        dtl_bytes = sum(int(r[0]) for r in exch)
+        drift_pct = (abs(rpc_dtl_bytes - dtl_bytes)
+                     / max(dtl_bytes, 1) * 100.0)
+
+        # the SQL face must agree with the raw scrape
+        sysstat = rows_of(sql(
+            "select stat_name, value from gv$sysstat"
+            " where stat_name = 'rpc.bytes{verb=dtl.execute}'"))
+        sql_face = int(sysstat[0][1]) if sysstat else 0
+
+        prom = clients[1].call("metrics.scrape", format="prom")
         return {
-            "rows": n_rows, "delay_ms": delay_ms,
-            "q6_under_fault_s": round(q6_s, 3),
-            "trace_id": trace_id, "trace_spans": len(spans),
-            "slowest_span": slowest[0],
-            "slowest_span_tags": tags,
-            "slowest_elapsed_s": round(float(slowest[2]), 3),
-            "attribution_ok": bool(ok), "parity": True,
+            "rows": n_rows, "nodes": len(clients),
+            "series_merged": len(flat),
+            "rpc_dtl_bytes": int(rpc_dtl_bytes),
+            "px_exchange_bytes": int(dtl_bytes),
+            "drift_pct": round(drift_pct, 4),
+            "sysstat_sql_face": sql_face,
+            "prom_lines": len(prom["text"].splitlines()),
+            "reconciled": bool(drift_pct <= 1.0 and dtl_bytes > 0
+                               and sql_face >= rpc_dtl_bytes * 0.99),
         }
     finally:
         for p in procs.values():
@@ -210,12 +216,12 @@ def bench_attribution(n_rows: int, seed: int = 7) -> dict:
 def main():
     n_rows = int(os.environ.get("BENCH_ROWS", "100000"))
     repeats = int(os.environ.get("BENCH_REPEATS", "40"))
-    out = {"metric": "trace_bench"}
+    out = {"metric": "metrics_bench"}
     out["overhead"] = bench_overhead(n_rows, repeats)
-    if not os.environ.get("TRACE_BENCH_SKIP_CLUSTER"):
-        out["attribution"] = bench_attribution(
+    if not os.environ.get("METRICS_BENCH_SKIP_CLUSTER"):
+        out["scrape"] = bench_scrape(
             int(os.environ.get("BENCH_CLUSTER_ROWS", "20000")))
-        out["ok"] = bool(out["attribution"]["attribution_ok"]
+        out["ok"] = bool(out["scrape"]["reconciled"]
                          and out["overhead"]["overhead_pct"] <= 2.0)
     else:
         out["ok"] = out["overhead"]["overhead_pct"] <= 2.0

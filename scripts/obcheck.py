@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""obcheck driver: trace-safety, mask-discipline, lock-order analysis.
+"""obcheck driver: trace-safety, mask-discipline, lock-order, and
+metric-discipline analysis.
 
     python scripts/obcheck.py                  # full report, exit 0
     python scripts/obcheck.py --ci             # fail (exit 1) on NEW
@@ -48,12 +49,16 @@ from oceanbase_tpu.analysis.lock_order import check_lock_order  # noqa: E402
 from oceanbase_tpu.analysis.mask_discipline import (  # noqa: E402
     check_mask_discipline,
 )
+from oceanbase_tpu.analysis.metric_rules import (  # noqa: E402
+    check_metric_rules,
+)
 from oceanbase_tpu.analysis.trace_safety import check_trace_safety  # noqa: E402
 
 CHECKERS = {
     "trace": check_trace_safety,
     "mask": check_mask_discipline,
     "lock": check_lock_order,
+    "metric": check_metric_rules,
 }
 
 
@@ -68,7 +73,7 @@ def main(argv=None) -> int:
     ap.add_argument("--root", default=REPO, help="repo root to scan")
     ap.add_argument("--baseline", default=core.BASELINE_PATH,
                     help="baseline file path")
-    ap.add_argument("--rules", default="trace,mask,lock",
+    ap.add_argument("--rules", default="trace,mask,lock,metric",
                     help="comma-separated rule families to run")
     args = ap.parse_args(argv)
 

@@ -91,6 +91,11 @@ def main():
             os.path.join(root, "bucketed"), steps, rows_per_step,
             buckets=True)
         match = ex_res == bk_res
+        # bench artifacts and the metrics plane share one schema: embed
+        # this process's gv$sysstat snapshot (plan.* compile counters
+        # and the bucket policy's effect live in the same series)
+        from oceanbase_tpu.server import metrics as qmetrics
+
         print(json.dumps({
             "metric": "recompile_amortization",
             "steps": steps,
@@ -104,6 +109,7 @@ def main():
             "loop_s_exact": round(ex_s, 3),
             "loop_s_bucketed": round(bk_s, 3),
             "results_match": bool(match),
+            "sysstat": qmetrics.sysstat_dict(),
         }))
         if not match:
             raise SystemExit("bucketed results diverge from exact")

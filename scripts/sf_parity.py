@@ -36,6 +36,7 @@ from oceanbase_tpu.bench.oracle import (  # noqa: E402
 from oceanbase_tpu.bench.tpch import (  # noqa: E402
     TPCH_PRIMARY_KEYS, gen_tpch)
 from oceanbase_tpu.bench.tpch_queries import QUERIES  # noqa: E402
+from oceanbase_tpu.server import metrics as qmetrics  # noqa: E402
 from oceanbase_tpu.sql import Session  # noqa: E402
 
 SF = float(os.environ.get("PARITY_SF", "1.0"))
@@ -77,6 +78,11 @@ def main():
         t0 = time.time()
         want = run_oracle(conn, sql)
         oracle_s = time.time() - t0
+        # per-query device attribution: the XLA cost_analysis counters
+        # (exec/plan.py) delta'd across the query — measured flops and
+        # bytes-accessed the cost-based-optimizer arc prices against
+        f0 = qmetrics.counter_value("plan.flops_executed")
+        b0 = qmetrics.counter_value("plan.bytes_executed")
         t0 = time.time()
         try:
             got = sess.execute(sql).rows()
@@ -88,13 +94,16 @@ def main():
             ok, why = False, f"{type(e).__name__}: {e}"
             got = []
         n_ok += bool(ok)
+        flops = qmetrics.counter_value("plan.flops_executed") - f0
+        nbytes = qmetrics.counter_value("plan.bytes_executed") - b0
         results[f"q{qnum}"] = {
             "ok": bool(ok), "rows": len(got), "oracle_rows": len(want),
             "engine_s": round(engine_s, 3), "oracle_s": round(oracle_s, 3),
+            "flops": int(flops), "bytes_accessed": int(nbytes),
             **({} if ok else {"why": why[:300]})}
         print(f"Q{qnum:02d}: {'OK ' if ok else 'FAIL'} "
               f"rows={len(got)} engine={engine_s:.2f}s "
-              f"oracle={oracle_s:.2f}s"
+              f"oracle={oracle_s:.2f}s gflops={flops / 1e9:.2f}"
               + ("" if ok else f"  [{why[:120]}]"), flush=True)
 
     artifact = {
@@ -104,6 +113,8 @@ def main():
         "host": {"nproc": os.cpu_count(),
                  "platform": "cpu (no TPU this window — see TPU_PROBE log)"},
         "results": results,
+        # bench artifacts and the metrics plane share one schema
+        "sysstat": qmetrics.sysstat_dict(),
     }
     with open(OUT, "w") as fh:
         json.dump(artifact, fh, indent=1)
