@@ -55,6 +55,11 @@ class TableDef:
     # STORAGE value domain, null_fraction) — ≙ ObOptColumnStat histogram
     # (src/share/stat/ob_opt_column_stat.h)
     histograms: dict = field(default_factory=dict)
+    # most-common-values lists from ANALYZE for dict-encoded string
+    # columns: col -> (values list, frequency-fraction list) — string
+    # equality selectivity reads the measured frequency instead of a
+    # guess (≙ ObOptColumnStat top-k frequency histogram)
+    mcv: dict = field(default_factory=dict)
     # range partitioning: (column, [upper-exclusive split points]) or None
     partition: tuple | None = None
     auto_increment_cols: list = field(default_factory=list)

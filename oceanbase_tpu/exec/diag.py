@@ -34,16 +34,30 @@ def collect():
         _collector.reset(tok)
 
 
-def push(name: str, scalar) -> None:
-    """Record a traced overflow scalar (no-op outside a collector)."""
+def push(name: str, scalar, capacity: int | None = None) -> None:
+    """Record a traced overflow scalar (no-op outside a collector).
+
+    ``capacity`` is the STATIC budget of the operator that pushed the
+    lane (known at trace time): the executor pairs it with the dropped
+    count so a CapacityOverflow can report how big the budget should
+    have been — the cardinality-feedback plane's overflow-time signal.
+    """
     entries = _collector.get()
     if entries is not None:
-        entries.append((name, scalar))
+        entries.append((name, scalar, capacity))
 
 
 class CapacityOverflow(RuntimeError):
     """Raised by the executor when an operator exceeded its static
-    capacity; callers re-plan with a larger budget (spill in later rounds)."""
+    capacity; callers re-plan with a larger budget (spill in later rounds).
+
+    ``drops`` holds ``(lane_name, static_capacity_or_None, rows_dropped)``
+    per overflowing diagnostic lane, so the retry path can jump straight
+    to a sufficient budget instead of blindly riding the 4x ladder."""
+
+    def __init__(self, msg: str, drops: list | None = None):
+        super().__init__(msg)
+        self.drops = drops or []
 
 
 # ---------------------------------------------------------------------------
@@ -67,7 +81,10 @@ def monitor_collect():
         _monitor.reset(tok)
 
 
-def monitor_push(op_name: str, count_scalar) -> None:
+def monitor_push(op_name: str, count_scalar, est: int | None = None) -> None:
+    """Record one operator's live-row output scalar plus the optimizer's
+    STATIC cardinality estimate for that operator (None = unknown) — the
+    estimate rides host-side, only the count is traced."""
     entries = _monitor.get()
     if entries is not None:
-        entries.append((op_name, count_scalar))
+        entries.append((op_name, est, count_scalar))

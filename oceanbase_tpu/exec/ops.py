@@ -296,7 +296,7 @@ def hash_groupby(
     # groups beyond capacity would vanish silently — surface it (diag when
     # lowered via execute_plan, explicit lane for shard_map callers)
     gb_overflow = jnp.maximum(n_groups - cap, 0)
-    diag.push("groupby_overflow", gb_overflow)
+    diag.push("groupby_overflow", gb_overflow, capacity=cap)
 
     # first sorted position of each group -> group key values
     first_pos = jax.ops.segment_min(
@@ -665,7 +665,8 @@ def join(
     total = jnp.sum(ecounts)
     # static-capacity overflow is a hard error surfaced by the executor
     # (≙ DTL backpressure made compile-time; see exec/diag.py)
-    diag.push("join_overflow", jnp.maximum(total - cap, 0))
+    diag.push("join_overflow", jnp.maximum(total - cap, 0),
+              capacity=cap)
     start = jnp.cumsum(ecounts) - ecounts  # exclusive prefix
     probe_idx = jnp.repeat(jnp.arange(ln), ecounts, total_repeat_length=cap)
     out_live = jnp.arange(cap) < total

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import re
 import threading
 import time
 from dataclasses import dataclass, field
@@ -42,6 +43,21 @@ qmetrics.declare("plan.flops_compiled", "counter",
                  "XLA cost_analysis flops of freshly compiled programs")
 qmetrics.declare("plan.bytes_compiled", "counter",
                  "XLA cost_analysis bytes-accessed of compiled programs")
+qmetrics.declare("plan.qerror", "histogram",
+                 "worst per-operator estimate-vs-actual q-error per "
+                 "monitored execution (1.0 = perfect estimate)")
+qmetrics.declare("plan.capacity_retries", "counter",
+                 "CapacityOverflow re-plans (the retry ladder the "
+                 "cardinality-feedback store exists to shorten)")
+qmetrics.declare("plan.feedback_hits", "counter",
+                 "binds that found gv$plan_feedback rows for their "
+                 "logical plan hash")
+qmetrics.declare("plan.feedback_corrections", "counter",
+                 "operator capacities raised at bind time from "
+                 "observed cardinalities")
+qmetrics.declare("plan.regressions", "counter",
+                 "plan-regression watchdog flag transitions "
+                 "(gv$plan_history.regressed going up)")
 qmetrics.declare("plan.flops_executed", "counter",
                  "cost_analysis flops of the program behind each "
                  "execution (measured device work, the CBO's substrate)")
@@ -123,17 +139,29 @@ class PlanNode:
         return repr(self)
 
 
+# Optimizer cardinality estimate riding every node (None = unknown).
+# Excluded from repr/compare on purpose: the estimate is METADATA — two
+# plans differing only in est_rows must share one fingerprint (and thus
+# one compiled XLA executable); stats drifting as a table grows must
+# never force a retrace.  The plan monitor pairs it with the measured
+# output rows into the q-error ledger (gv$sql_plan_monitor).
+def _est_field():
+    return field(default=None, repr=False, compare=False)
+
+
 @dataclass(repr=True)
 class TableScan(PlanNode):
     table: str
     columns: Optional[list[str]] = None  # projection pushdown
     rename: Optional[dict[str, str]] = None  # output qualification
+    est_rows: Optional[int] = _est_field()
 
 
 @dataclass(repr=True)
 class Filter(PlanNode):
     child: PlanNode
     pred: ir.Expr
+    est_rows: Optional[int] = _est_field()
 
     def children(self):
         return (self.child,)
@@ -143,6 +171,7 @@ class Filter(PlanNode):
 class Project(PlanNode):
     child: PlanNode
     outputs: dict  # name -> ir.Expr
+    est_rows: Optional[int] = _est_field()
 
     def children(self):
         return (self.child,)
@@ -154,6 +183,7 @@ class GroupBy(PlanNode):
     keys: dict  # name -> ir.Expr
     aggs: list  # list[AggSpec]
     out_capacity: Optional[int] = None
+    est_rows: Optional[int] = _est_field()
 
     def children(self):
         return (self.child,)
@@ -163,6 +193,7 @@ class GroupBy(PlanNode):
 class ScalarAgg(PlanNode):
     child: PlanNode
     aggs: list
+    est_rows: Optional[int] = _est_field()
 
     def children(self):
         return (self.child,)
@@ -176,6 +207,7 @@ class HashJoin(PlanNode):
     right_keys: list
     how: str = "inner"
     out_capacity: Optional[int] = None
+    est_rows: Optional[int] = _est_field()
 
     def children(self):
         return (self.left, self.right)
@@ -193,6 +225,7 @@ class SemiJoinResidual(PlanNode):
     residual: list
     anti: bool = False
     out_capacity: Optional[int] = None
+    est_rows: Optional[int] = _est_field()
 
     def children(self):
         return (self.left, self.right)
@@ -205,6 +238,7 @@ class Window(PlanNode):
 
     child: PlanNode
     specs: list  # list[(out_colid, ir.WindowCall)]
+    est_rows: Optional[int] = _est_field()
 
     def children(self):
         return (self.child,)
@@ -215,6 +249,7 @@ class Union(PlanNode):
     """UNION ALL (concat); distinct layered via GroupBy above."""
 
     inputs: list
+    est_rows: Optional[int] = _est_field()
 
     def children(self):
         return tuple(self.inputs)
@@ -225,6 +260,7 @@ class Sort(PlanNode):
     child: PlanNode
     keys: list
     ascending: Optional[list] = None
+    est_rows: Optional[int] = _est_field()
 
     def children(self):
         return (self.child,)
@@ -235,6 +271,7 @@ class Limit(PlanNode):
     child: PlanNode
     k: int
     offset: int = 0
+    est_rows: Optional[int] = _est_field()
 
     def children(self):
         return (self.child,)
@@ -246,9 +283,136 @@ class Compact(PlanNode):
 
     child: PlanNode
     capacity: Optional[int] = None
+    est_rows: Optional[int] = _est_field()
 
     def children(self):
         return (self.child,)
+
+
+# ---------------------------------------------------------------------------
+# plan-quality metadata: logical hash + estimate propagation
+# ---------------------------------------------------------------------------
+
+
+def _logical_repr(node: PlanNode) -> str:
+    """Capacity-insensitive rendering: two plans that differ only in
+    their static budgets (out_capacity scaling after CapacityOverflow)
+    or estimates render identically — the key the cardinality-feedback
+    store and the plan-regression watchdog aggregate on."""
+    parts = []
+    for k, v in vars(node).items():
+        if k in ("out_capacity", "capacity", "est_rows") or \
+                k.startswith("_"):
+            continue
+        if isinstance(v, PlanNode) or k in ("child", "left", "right",
+                                            "inputs"):
+            continue
+        if isinstance(v, str) and k in ("table", "index", "name"):
+            # hex-protect object identifiers: the colid normalization
+            # below strips ``_<digits>`` suffixes, which would conflate
+            # events_2024 and events_2025 into ONE feedback/history key
+            # (capacity corrections and regression baselines would leak
+            # across distinct tables); hex output contains no
+            # underscores, so the regex cannot touch it
+            parts.append(f"{k}={v.encode().hex()}")
+            continue
+        parts.append(f"{k}={v!r}")
+    kids = ",".join(_logical_repr(c) for c in node.children())
+    return f"{type(node).__name__}({','.join(parts)})[{kids}]"
+
+
+_COLID_SEQ = re.compile(r"_\d+\b")
+
+
+def logical_hash(node: PlanNode) -> str:
+    """Stable digest of the plan MINUS capacities/estimates: the
+    gv$plan_feedback / gv$plan_history key (a capacity retry or a stats
+    refresh must not open a fresh history).
+
+    Binder colids embed a session-global counter (``a_k_5``, ``o_9``),
+    so the raw repr would hash differently on every rebind of the same
+    statement — the counter suffixes are normalized away.  Table/index
+    identifiers are hex-protected in _logical_repr so distinct tables
+    never share a key; a string LITERAL ending in ``_<digits>`` still
+    normalizes (worst case: two same-shaped predicates share one
+    history, and apply_feedback's op-name check guards corrections).
+
+    Memoized on the node (plans are treated as immutable once built;
+    cached plans would otherwise pay the whole-tree render + digest on
+    every execution)."""
+    h = node.__dict__.get("_logical_hash")
+    if h is None:
+        text = _COLID_SEQ.sub("", _logical_repr(node))
+        h = hashlib.md5(text.encode()).hexdigest()[:16]
+        node.__dict__["_logical_hash"] = h
+    return h
+
+
+def propagate_estimates(node: PlanNode,
+                        row_counts: dict | None = None) -> PlanNode:
+    """Fill missing ``est_rows`` from the children (post-bind pass): the
+    binder annotates the nodes it has real estimates for; everything
+    else inherits a defensible bound so EVERY operator row in
+    gv$sql_plan_monitor carries an estimate to q-error against.
+    ``row_counts`` maps table -> live rows for un-annotated scans."""
+    import dataclasses
+
+    kids: dict = {}
+    changed = False
+    for fname in ("child", "left", "right"):
+        if hasattr(node, fname):
+            old = getattr(node, fname)
+            nv = propagate_estimates(old, row_counts)
+            kids[fname] = nv
+            changed = changed or nv is not old
+    if hasattr(node, "inputs"):
+        nv_list = [propagate_estimates(c, row_counts)
+                   for c in node.inputs]
+        kids["inputs"] = nv_list
+        changed = changed or any(a is not b for a, b in
+                                 zip(nv_list, node.inputs))
+    est = node.est_rows
+    if est is None:
+        if isinstance(node, TableScan):
+            est = (row_counts or {}).get(node.table)
+        elif isinstance(node, ScalarAgg):
+            est = 1
+        elif isinstance(node, Limit):
+            ce = kids["child"].est_rows
+            k = node.k + (node.offset or 0)
+            est = k if ce is None else min(k, ce)
+        elif isinstance(node, Union):
+            subs = [c.est_rows for c in kids["inputs"]]
+            known = [s for s in subs if s is not None]
+            est = sum(known) if known else None
+        elif isinstance(node, (HashJoin, SemiJoinResidual)):
+            le = kids["left"].est_rows
+            re_ = kids["right"].est_rows
+            known = [v for v in (le, re_) if v is not None]
+            est = max(known) if known else None
+        elif "child" in kids:
+            # single-child pass-through (Filter/Project/Sort/Window/
+            # Compact/GroupBy without a binder estimate): the child's
+            # cardinality is an upper bound
+            est = kids["child"].est_rows
+    if est is not None:
+        est = max(int(est), 1)
+    if est == node.est_rows and not changed:
+        return node
+    updates = dict(kids)
+    if est != node.est_rows:
+        updates["est_rows"] = est
+    return dataclasses.replace(node, **updates)
+
+
+def q_error(est: int | None, act: int) -> float:
+    """Symmetric misestimate factor max(est/act, act/est), >= 1.0
+    (0.0 = no estimate to compare).  The CBO literature's q-error."""
+    if est is None:
+        return 0.0
+    e = max(float(est), 1.0)
+    a = max(float(act), 1.0)
+    return max(e / a, a / e)
 
 
 # ---------------------------------------------------------------------------
@@ -256,10 +420,47 @@ class Compact(PlanNode):
 # ---------------------------------------------------------------------------
 
 
-def _lower(node: PlanNode, tables: dict[str, Relation]) -> Relation:
+# pass-through operators preserve cardinality exactly (their output
+# rows ≡ the child's), so a monitor lane on them would duplicate the
+# child's ledger row while paying a real per-lane count inside the
+# fused program — the ≤2% monitoring-overhead contract's biggest lever
+PASSTHROUGH_OPS = ("Project", "Sort", "Compact", "Window")
+
+
+def monitored_op(node: PlanNode, parent: "PlanNode | None" = None) -> bool:
+    """Does this operator get its own estimate-vs-actual ledger row?
+
+    Pass-through operators never do.  An inner Filter of a conjunct
+    chain doesn't either: only the TOPMOST filter's output cardinality
+    reaches the rest of the plan, and the binder splits one WHERE into
+    a Filter per conjunct — monitoring each would pay one mask
+    reduction per conjunct for rows that duplicate the chain head's."""
+    if type(node).__name__ in PASSTHROUGH_OPS:
+        return False
+    return not (isinstance(node, Filter) and isinstance(parent, Filter))
+
+
+def monitored_postorder(node: PlanNode,
+                        parent: "PlanNode | None" = None) -> list:
+    """The plan nodes that emit monitor lanes, in executor postorder —
+    1:1 with a monitored execution's op_stats rows."""
+    out = []
+    for c in node.children():
+        out.extend(monitored_postorder(c, node))
+    if monitored_op(node, parent):
+        out.append(node)
+    return out
+
+
+def _lower(node: PlanNode, tables: dict[str, Relation],
+           parent: "PlanNode | None" = None) -> Relation:
     rel = _lower_inner(node, tables)
-    # per-operator row accounting (no-op unless a monitor is collecting)
-    diag.monitor_push(type(node).__name__, rel.count())
+    # per-operator row accounting (no-op unless a monitor is collecting);
+    # the optimizer's static estimate rides along host-side so the
+    # monitor can q-error it against the measured count
+    if monitored_op(node, parent):
+        diag.monitor_push(type(node).__name__, rel.count(),
+                          est=node.est_rows)
     return rel
 
 
@@ -275,48 +476,58 @@ def _lower_inner(node: PlanNode, tables: dict[str, Relation]) -> Relation:
             )
         return rel
     if isinstance(node, Filter):
-        return ops.filter_rows(_lower(node.child, tables), node.pred)
+        return ops.filter_rows(_lower(node.child, tables, node),
+                               node.pred)
     if isinstance(node, Project):
-        return ops.project(_lower(node.child, tables), node.outputs)
+        return ops.project(_lower(node.child, tables, node),
+                           node.outputs)
     if isinstance(node, GroupBy):
         return ops.hash_groupby(
-            _lower(node.child, tables), node.keys, node.aggs,
+            _lower(node.child, tables, node), node.keys, node.aggs,
             out_capacity=node.out_capacity,
         )
     if isinstance(node, ScalarAgg):
-        return ops.scalar_agg(_lower(node.child, tables), node.aggs)
+        return ops.scalar_agg(_lower(node.child, tables, node),
+                              node.aggs)
     if isinstance(node, HashJoin):
         return ops.join(
-            _lower(node.left, tables), _lower(node.right, tables),
+            _lower(node.left, tables, node),
+            _lower(node.right, tables, node),
             node.left_keys, node.right_keys, how=node.how,
             out_capacity=node.out_capacity,
         )
     if isinstance(node, SemiJoinResidual):
         return ops.semi_join_residual(
-            _lower(node.left, tables), _lower(node.right, tables),
+            _lower(node.left, tables, node),
+            _lower(node.right, tables, node),
             node.left_keys, node.right_keys, node.residual,
             anti=node.anti, out_capacity=node.out_capacity,
         )
     if isinstance(node, Union):
-        return ops.concat([_lower(c, tables) for c in node.inputs])
+        return ops.concat([_lower(c, tables, node)
+                           for c in node.inputs])
     if isinstance(node, Window):
         from oceanbase_tpu.exec.window import window as window_op
 
-        return window_op(_lower(node.child, tables), node.specs)
+        return window_op(_lower(node.child, tables, node), node.specs)
     if isinstance(node, Sort):
-        return ops.sort_rows(_lower(node.child, tables), node.keys, node.ascending)
+        return ops.sort_rows(_lower(node.child, tables, node),
+                             node.keys, node.ascending)
     if isinstance(node, Limit):
         child = node.child
         if (isinstance(child, Sort) and node.offset == 0
                 and node.k <= 4096 and len(child.keys) == 1):
             # fused top-N (single key; dictionary codes are order-preserving
-            # so string keys qualify too)
+            # so string keys qualify too): the Sort never lowers, so its
+            # child's monitor lane parents to the Limit
             asc = child.ascending[0] if child.ascending else True
-            return ops.top_n(_lower(child.child, tables), child.keys[0],
-                             asc, node.k)
-        return ops.limit(_lower(node.child, tables), node.k, node.offset)
+            return ops.top_n(_lower(child.child, tables, node),
+                             child.keys[0], asc, node.k)
+        return ops.limit(_lower(node.child, tables, node), node.k,
+                         node.offset)
     if isinstance(node, Compact):
-        return ops.compact(_lower(node.child, tables), node.capacity)
+        return ops.compact(_lower(node.child, tables, node),
+                           node.capacity)
     raise NotImplementedError(type(node).__name__)
 
 
@@ -408,13 +619,25 @@ class _PlanExecutable:
                     with diag.monitor_collect() as mons:
                         out = _lower(plan, tables)
                     monitor_names.clear()
-                    monitor_names.extend(n for n, _ in mons)
-                    mvals = [v for _, v in mons]
+                    # (op name, static est) pairs; only the count lane
+                    # is traced
+                    monitor_names.extend((n, e) for n, e, _ in mons)
+                    mvals = [v for _, _, v in mons]
                 else:
                     out = _lower(plan, tables)
                     mvals = []
+                import jax.numpy as _jnp
+
+                # ONE stacked vector instead of N scalars: the host
+                # reads all per-op counts in a single device transfer
+                # (N blocking syncs per execution would dominate the
+                # monitoring overhead budget)
+                mon_vec = (_jnp.stack([_jnp.asarray(v, dtype=_jnp.int64)
+                                       for v in mvals])
+                           if mvals else _jnp.zeros((0,), _jnp.int64))
             diag_names.clear()
-            diag_names.extend(n for n, _ in entries)
+            # (lane name, static capacity) pairs for the overflow report
+            diag_names.extend((n, cap) for n, _, cap in entries)
             # fold the per-operator overflow lanes into ONE scalar on
             # device: the per-execute host check reads a single value
             # instead of syncing once per diagnostic lane (obcheck
@@ -422,10 +645,10 @@ class _PlanExecutable:
             import jax.numpy as jnp
 
             total = jnp.zeros((), dtype=jnp.int64)
-            for _n, v in entries:
+            for _n, v, _cap in entries:
                 total = total + jnp.maximum(
                     jnp.asarray(v, dtype=jnp.int64), 0)
-            return out, [v for _, v in entries], total, mvals
+            return out, [v for _, v, _ in entries], total, mon_vec
 
         # only ever driven through .lower()/.compile(): the jit wrapper
         # exists for the lowering machinery (and so obcheck keeps seeing
@@ -473,6 +696,30 @@ class _PlanExecutable:
         return exe(tables), compiled_now
 
 
+# per-thread statement-scoped compile marker: the session resets it
+# before a statement's retry ladder and the plan-regression watchdog
+# skips samples whose wall time includes an XLA compile (or a retry
+# replay) — otherwise the warmup baseline freezes at compile-inflated
+# latency and real steady-state regressions never cross the threshold
+_exec_flags = threading.local()
+
+
+def reset_compile_flag():
+    _exec_flags.compiled = False
+
+
+def compile_flag() -> bool:
+    """Did any plan compilation happen on this thread since the last
+    reset_compile_flag()?"""
+    return bool(getattr(_exec_flags, "compiled", False))
+
+
+def mark_compiled():
+    """For non-execute_plan compile paths (PX shard_map programs) to
+    join the same statement-scoped exclusion."""
+    _exec_flags.compiled = True
+
+
 @functools.lru_cache(maxsize=256)
 def _compiled(plan_key, plan_holder, with_monitor=False):
     # the stats object rides along with the executable bundle: callers
@@ -499,12 +746,23 @@ class _PlanHolder:
 
 def execute_plan(plan: PlanNode, tables: dict[str, Relation],
                  check_overflow: bool = True,
-                 monitor_out: list | None = None) -> Relation:
+                 monitor_out: list | None = None,
+                 monitor_collect: bool = True,
+                 op_spans: bool = True) -> Relation:
     """Compile (cached) + run a plan against device tables.
 
     ≙ ObExecutor::execute_plan (src/sql/executor/ob_executor.cpp:37); the
     compilation cache here is the engine-level analog of the plan cache
     (ObPlanCache::get_plan, src/sql/plan_cache/ob_plan_cache.cpp:579).
+
+    ``monitor_out`` selects the executable VARIANT (with/without monitor
+    lanes) — it must be stable per plan across executions or the plan
+    compiles twice and breaks the shape-bucket compile-count invariant.
+    ``monitor_collect`` is the cheap per-execution sampling switch: when
+    False the lanes still run on device (same executable) but the host
+    skips the transfer, the ledger rows, and the op spans.  ``op_spans``
+    suppresses the per-operator trace spans (DTL fragments ship the
+    compact ``ops`` reply field instead of paying span wire cost).
 
     Raises diag.CapacityOverflow when any static-capacity operator
     (join expansion, exchange buffer) overflowed — results would be
@@ -525,10 +783,11 @@ def execute_plan(plan: PlanNode, tables: dict[str, Relation],
         (out, diag_vals, diag_total, mon_vals), compiled_now = \
             bundle.call({k: v for k, v in tables.items() if k in needed})
         stats.executions += 1
+        plan_elapsed = time.perf_counter() - t0
         qmetrics.inc("plan.executions", op=root_op)
-        qmetrics.observe("plan.execute_s", time.perf_counter() - t0,
-                         op=root_op)
+        qmetrics.observe("plan.execute_s", plan_elapsed, op=root_op)
         if compiled_now:
+            _exec_flags.compiled = True
             tsp.tags["compiled"] = 1
             # compile-vs-execute attribution: the lower+compile wall
             # time IS the XLA trace+compile cost the shape-bucket
@@ -539,28 +798,62 @@ def execute_plan(plan: PlanNode, tables: dict[str, Relation],
                             flops=stats.flops,
                             bytes_accessed=stats.bytes_accessed,
                             peak_memory=stats.peak_memory)
-        if with_monitor:
+        if with_monitor and monitor_collect:
             # audited: opt-in plan-monitor collection materializes
-            # per-op row counts; only with enable_sql_plan_monitor set
-            op_rows = [  # obcheck: ok(trace.host-sync)
-                (n, int(v)) for n, v in zip(monitor_names, mon_vals)]
+            # per-op row counts; only with enable_sql_plan_monitor set.
+            # Each row is the estimate-vs-actual ledger entry: the
+            # binder's est_rows beside the measured output rows with
+            # their q-error (gv$sql_plan_monitor row shape).
+            import numpy as _np
+
+            # audited result-boundary sync: ONE transfer materializes
+            # every per-op count
+            mon_host = _np.asarray(mon_vals)  # obcheck: ok(trace.host-sync)
+            # estimates come from the CURRENT plan, not the ones the
+            # cached executable captured at trace time: the compile
+            # cache keys on fingerprint() (est-insensitive by design),
+            # so after ANALYZE / table growth a re-bound plan reuses
+            # the executable but must report its own refreshed est_rows
+            live = monitored_postorder(plan)
+            ests = ([n.est_rows for n in live]
+                    if len(live) == len(monitor_names)
+                    else [e for _, e in monitor_names])
+            op_rows = []
+            for i, ((n, _tr_est), v) in enumerate(
+                    zip(monitor_names, mon_host)):
+                est = ests[i]
+                act = int(v)
+                op_rows.append({"op": n, "pos": i, "est": est,
+                                "rows": act, "q_error": q_error(est, act),
+                                "elapsed_s": 0.0})
+            if op_rows:
+                # the plan runs as ONE fused XLA program, so per-op wall
+                # time is not separable; the root carries the plan total
+                op_rows[-1]["elapsed_s"] = plan_elapsed
+                worst = max(op_rows, key=lambda r: r["q_error"])
+                if worst["q_error"] > 0.0:
+                    qmetrics.observe("plan.qerror", worst["q_error"])
             monitor_out.extend(op_rows)
-            if qtrace.current() is not None:
+            if op_spans and qtrace.current() is not None:
                 # per-operator breakdown under the plan.execute span
-                # (the plan-monitor lanes already paid the transfer)
-                for n, cnt in op_rows:
-                    qtrace.add_span("op." + n, 0.0, rows=cnt)
+                # (the plan-monitor lanes already paid the transfer;
+                # bulk emission pays one lock, not one per op)
+                qtrace.add_spans([
+                    ("op." + r["op"], 0.0,
+                     {"rows": r["rows"], "est": r["est"] or 0,
+                      "q": round(r["q_error"], 3)})
+                    for r in op_rows])
     if check_overflow and diag_vals:
         # audited result-boundary sync: ONE host read decides validity;
         # the per-lane detail below only materializes on the error path
         total = int(diag_total)  # obcheck: ok(trace.host-sync)
         if total > 0:
             vals = [int(v) for v in diag_vals]  # obcheck: ok(trace.host-sync)
-            detail = ", ".join(
-                f"{n}={v}" for n, v in zip(diag_names, vals) if v > 0
-            )
+            drops = [(n, cap, v)
+                     for (n, cap), v in zip(diag_names, vals) if v > 0]
+            detail = ", ".join(f"{n}={v}" for n, _cap, v in drops)
             raise diag.CapacityOverflow(
                 f"operator capacity exceeded ({detail} rows dropped); "
-                f"re-plan with larger out_capacity"
+                f"re-plan with larger out_capacity", drops=drops,
             )
     return out

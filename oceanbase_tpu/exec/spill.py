@@ -81,7 +81,7 @@ def partitioned_join(
             with diag.collect() as entries:
                 j = ops.join(lrel, rrel, lkeys_e, rkeys_e, how=how,
                              out_capacity=cap)
-                dropped = sum(int(v) for _name, v in entries)
+                dropped = sum(int(v) for _name, v, _cap in entries)
             if dropped == 0:
                 break
             cap *= 4  # ≙ recursive re-partition: grow and redo this pair

@@ -400,7 +400,7 @@ def _stream_join(ctx: _Ctx, node: pp.HashJoin):
                     else:
                         j = ops.join(build_rel, srel, bkeys, skeys,
                                      how=node.how, out_capacity=cap)
-                    dropped = sum(int(v) for _nm, v in entries)
+                    dropped = sum(int(v) for _nm, v, _cap in entries)
                 if dropped == 0:
                     break
                 cap *= 4

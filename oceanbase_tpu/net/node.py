@@ -54,6 +54,8 @@ class NodeDatabase:
         from oceanbase_tpu.px.dtl import DtlMetrics
         from oceanbase_tpu.server.monitor import (
             AshSampler,
+            PlanFeedback,
+            PlanHistory,
             PlanMonitor,
             SqlAudit,
             WaitEvents,
@@ -68,6 +70,10 @@ class NodeDatabase:
         self.tenants = {"sys": node.tenant}
         self.workarea_history: list = []
         self.plan_monitor = PlanMonitor()
+        self.plan_feedback = PlanFeedback(
+            int(self.config["plan_feedback_entries"]))
+        self.plan_history = PlanHistory(
+            int(self.config["plan_history_entries"]))
         self.audit = SqlAudit(int(self.config["sql_audit_queue_size"]))
         self.wait_events = WaitEvents()
         # ASH + full-link trace ring: NodeServer.start()/stop() drive
@@ -354,7 +360,8 @@ class NodeServer:
 
     def _h_dtl_execute(self, plan: dict, table: str, snapshot: int,
                        part: int = 0, nparts: int = 1,
-                       applied_lsn: int = 0):
+                       applied_lsn: int = 0, with_ops: bool = False,
+                       monitor_lanes: bool = False):
         """Execute one DTL partial-plan slice against the local replica
         (≙ the SQC running its DFO over local tablets and streaming
         exchange rows back; px/dtl.py holds the plan wire codec).
@@ -375,10 +382,16 @@ class NodeServer:
                 f"{self.palf.replica.applied_lsn} < {applied_lsn}")
         from oceanbase_tpu.server import trace as qtrace
 
+        # monitor_lanes is the COORDINATOR's monitor-knob state: it
+        # picks the fragment executable variant here, so the per-query
+        # sampling decision (with_ops) never alternates the compile key
+        # (see dtl.execute_fragment's monitor_lanes contract)
         with qtrace.span("dtl.fragment", table=table,
                          part=int(part)) as sp:
             out = dtl.execute_fragment(ts, plan, int(snapshot),
-                                       int(part), int(nparts))
+                                       int(part), int(nparts),
+                                       with_ops=bool(with_ops),
+                                       monitor_lanes=bool(monitor_lanes))
             sp.tags.update(rows=out["rows"], scanned=out["scanned"])
             return out
 

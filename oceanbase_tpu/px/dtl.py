@@ -62,6 +62,10 @@ qmetrics.declare("dtl.avoided_parts", "counter",
                  "slices routed locally pre-emptively (unhealthy peer)")
 qmetrics.declare("dtl.exchange_s", "histogram",
                  "whole-exchange wall time", unit="s")
+qmetrics.declare("dtl.slice_skew", "histogram",
+                 "max/mean output rows across one exchange's slices "
+                 "(1.0 = perfectly balanced; partition skew the CBO "
+                 "must price around)")
 
 #: name of the coordinator-side relation holding the merged exchange rows
 DTL_TABLE = "__dtl_recv__"
@@ -353,21 +357,29 @@ def split_pushdown(plan: pp.PlanNode) -> PushPlan | None:
     try:
         if is_agg:
             partial, final, post = split_aggs(target.aggs)
+            # est_rows rides the constructed halves (metadata only —
+            # fingerprints ignore it): the coordinator q-errors the
+            # summed per-slice partial outputs against the original
+            # node's estimate
             if isinstance(target, pp.GroupBy):
                 remote = pp.GroupBy(target.child, target.keys, partial,
-                                    out_capacity=target.out_capacity)
+                                    out_capacity=target.out_capacity,
+                                    est_rows=target.est_rows)
                 merged = pp.GroupBy(
                     pp.TableScan(DTL_TABLE),
                     {k: ir.col(k) for k in target.keys}, final,
-                    out_capacity=target.out_capacity)
+                    out_capacity=target.out_capacity,
+                    est_rows=target.est_rows)
                 outs = {k: ir.col(k) for k in target.keys}
                 outs.update(post)
-                repl = pp.Project(merged, outs)
+                repl = pp.Project(merged, outs,
+                                  est_rows=target.est_rows)
             else:
-                remote = pp.ScalarAgg(target.child, partial)
+                remote = pp.ScalarAgg(target.child, partial, est_rows=1)
                 repl = pp.Project(
-                    pp.ScalarAgg(pp.TableScan(DTL_TABLE), final),
-                    dict(post))
+                    pp.ScalarAgg(pp.TableScan(DTL_TABLE), final,
+                                 est_rows=1),
+                    dict(post), est_rows=1)
         else:
             remote = target
             repl = pp.TableScan(DTL_TABLE)
@@ -436,12 +448,21 @@ def host_relation(arrays: dict, valids: dict, types: dict) -> Relation:
 
 
 def execute_fragment(ts, plan_enc: dict, snapshot: int, part: int,
-                     nparts: int) -> dict:
+                     nparts: int, with_ops: bool = False,
+                     monitor_lanes: bool = False) -> dict:
     """Run one partial-plan slice against a local tablet snapshot.
 
-    -> {"arrays", "valids", "types", "rows", "scanned"} — the wire shape
-    of one DTL exchange reply (arrays are host numpy, riding the codec's
-    binary buffer sections)."""
+    -> {"arrays", "valids", "types", "rows", "scanned"[, "ops"]} — the
+    wire shape of one DTL exchange reply (arrays are host numpy, riding
+    the codec's binary buffer sections).  With ``with_ops`` the reply
+    carries the slice's per-operator output rows in executor postorder
+    as a bare int list (the coordinator derives op names and estimates
+    from its own copy of the partial plan — ``spans``-style merge at a
+    fraction of the wire cost).  ``monitor_lanes`` mirrors the node's
+    ``enable_sql_plan_monitor`` knob so unsampled fragment executions
+    run the SAME monitored executable as sampled ones (the variant is
+    part of the compile key; alternating it would double the fragment
+    plan's XLA trace count)."""
     remote = decode_plan(plan_enc)
     scan = _find_scan(remote)
     arrays, valids = ts.tablet.snapshot_arrays(snapshot)
@@ -455,20 +476,25 @@ def execute_fragment(ts, plan_enc: dict, snapshot: int, part: int,
         scanned = int(m.sum())
     rel = host_relation(arrays, valids,
                         {c.name: c.dtype for c in ts.tdef.columns})
-    out = execute_plan(remote, {scan.table: rel})
+    mon = [] if (with_ops or monitor_lanes) else None
+    out = execute_plan(remote, {scan.table: rel}, monitor_out=mon,
+                       monitor_collect=with_ops, op_spans=False)
     raw = to_numpy(out)
     r_arrays = {k: v for k, v in raw.items()
                 if not k.startswith("__valid__")}
     r_valids = {k[len("__valid__"):]: v for k, v in raw.items()
                 if k.startswith("__valid__")}
     rows = len(next(iter(r_arrays.values()))) if r_arrays else 0
-    return {
+    reply = {
         "arrays": r_arrays, "valids": r_valids,
         "types": {name: [c.dtype.kind.value, c.dtype.precision or 0,
                          c.dtype.scale or 0]
                   for name, c in out.columns.items()},
         "rows": rows, "scanned": scanned,
     }
+    if with_ops:
+        reply["ops"] = [int(r["rows"]) for r in mon]
+    return reply
 
 
 def merge_fragments(parts: list[dict]) -> Relation:
@@ -517,6 +543,20 @@ class DtlRecord:
     fallback_parts: int = 0    # slices re-run locally AFTER a failure
     avoided_parts: int = 0     # slices routed locally PRE-EMPTIVELY
     elapsed_s: float = 0.0
+    # per-slice attribution (index = part number): output rows, wire
+    # bytes (0 for locally-run slices) and wall seconds per slice —
+    # partition skew made visible before the CBO has to price it
+    slice_rows: list = field(default_factory=list)
+    slice_bytes: list = field(default_factory=list)
+    slice_elapsed: list = field(default_factory=list)
+
+    @property
+    def slice_skew(self) -> float:
+        """max/mean output rows across slices (0.0 = no slice data)."""
+        if not self.slice_rows:
+            return 0.0
+        mean = sum(self.slice_rows) / len(self.slice_rows)
+        return (max(self.slice_rows) / mean) if mean > 0 else 0.0
 
 
 class DtlMetrics:
@@ -549,6 +589,9 @@ class DtlMetrics:
         if rec.avoided_parts:
             qmetrics.inc("dtl.avoided_parts", rec.avoided_parts)
         qmetrics.observe("dtl.exchange_s", rec.elapsed_s, mode=rec.mode)
+        skew = rec.slice_skew
+        if skew > 0.0:
+            qmetrics.observe("dtl.slice_skew", skew)
 
     def recent(self, n: int = 100) -> list:
         with self._lock:
@@ -597,10 +640,16 @@ class DtlExchange:
                 self._chan[pid] = cli
             return cli
 
-    def try_execute(self, plan: pp.PlanNode, monitor: list | None = None):
+    def try_execute(self, plan: pp.PlanNode, monitor: list | None = None,
+                    collect: bool = True):
         """-> merged Relation, or None to fall back to the serial path.
         Raises CapacityOverflow (propagating a remote overflow) so the
-        session's retry ladder re-plans with larger budgets."""
+        session's retry ladder re-plans with larger budgets.
+
+        ``monitor`` non-None keeps the merge plan's monitored executable
+        variant stable while ``collect`` (the session's per-plan sampling
+        decision) gates the actual ledger work: per-op reply rows are
+        only requested — and wire bytes only paid — on sampled runs."""
         node = self.node
         try:
             if not bool(node.config["enable_dtl_pushdown"]):
@@ -646,7 +695,14 @@ class DtlExchange:
         m0 = time.monotonic()  # elapsed source (step-proof)
         results: list = [None] * nparts
         ship_bytes = [0] * nparts
+        slice_s = [0.0] * nparts
         errors: list = [None] * nparts
+        # want_lanes is the coordinator's (stable) monitor-knob state —
+        # it picks the fragment executable VARIANT on every data node,
+        # so sampling (want_ops) never alternates the compile key even
+        # when a node's own knob setting differs from the coordinator's
+        want_lanes = monitor is not None
+        want_ops = want_lanes and collect
         # full-link trace: the fan-out/merge runs under one exchange
         # span; worker threads re-activate the statement's context so
         # per-slice spans (and the rpc spans beneath them, carrying the
@@ -661,16 +717,19 @@ class DtlExchange:
                 with qtrace.activate(tctx, tparent):
                     with qtrace.span("dtl.slice", part=i,
                                      peer=cli.peer_id):
+                        s0 = time.monotonic()
                         try:
                             res, sent, recv = cli.call_with_size(
                                 "dtl.execute", plan=push.encoded,
                                 table=push.table, snapshot=snap,
                                 part=i, nparts=nparts,
-                                applied_lsn=lsn)
+                                applied_lsn=lsn, with_ops=want_ops,
+                                monitor_lanes=want_lanes)
                             results[i] = res
                             ship_bytes[i] = sent + recv
                         except Exception as e:  # noqa: BLE001 — triaged
                             errors[i] = e
+                        slice_s[i] = time.monotonic() - s0
 
             threads = [threading.Thread(target=run_peer, args=(i, cli),
                                         daemon=True)
@@ -681,9 +740,12 @@ class DtlExchange:
             # from an unhealthy peer — runs locally while peers work
             for i in avoided_parts:
                 with qtrace.span("dtl.slice", part=i, local=1):
+                    s0 = time.monotonic()
                     results[i] = node._h_dtl_execute(
                         plan=push.encoded, table=push.table,
-                        snapshot=snap, part=i, nparts=nparts)
+                        snapshot=snap, part=i, nparts=nparts,
+                        with_ops=want_ops, monitor_lanes=want_lanes)
+                    slice_s[i] = time.monotonic() - s0
             for t in threads:
                 t.join()
             fallbacks = 0
@@ -704,9 +766,12 @@ class DtlExchange:
                 # run that slice on the local replica instead
                 with qtrace.span("dtl.slice", part=i, local=1,
                                  fallback=1):
+                    s0 = time.monotonic()
                     results[i] = node._h_dtl_execute(
                         plan=push.encoded, table=push.table,
-                        snapshot=snap, part=i, nparts=nparts)
+                        snapshot=snap, part=i, nparts=nparts,
+                        with_ops=want_ops, monitor_lanes=want_lanes)
+                    slice_s[i] = time.monotonic() - s0
                 fallbacks += 1
             if node.palf.replica.applied_lsn != lsn:
                 # a commit landed while slices were executing: its
@@ -717,10 +782,12 @@ class DtlExchange:
                 # path re-reads one replica consistently.
                 xsp.tags["discarded"] = 1
                 return None
+            merge_mon = [] if monitor is not None else None
             with qtrace.span("dtl.merge", parts=nparts):
                 rel = merge_fragments(results)
                 out = execute_plan(push.rebuilt, {DTL_TABLE: rel},
-                                   monitor_out=monitor)
+                                   monitor_out=merge_mon,
+                                   monitor_collect=collect)
             rows_shipped = sum(r["rows"] for i, r in enumerate(results)
                                if i > 0 and ship_bytes[i] > 0)
             elapsed = time.monotonic() - m0
@@ -729,17 +796,58 @@ class DtlExchange:
                 pushdown_hit=True, bytes_shipped=sum(ship_bytes),
                 rows_shipped=rows_shipped, fallback_parts=fallbacks,
                 avoided_parts=len(avoided_parts) - 1,
-                elapsed_s=elapsed)
+                elapsed_s=elapsed,
+                slice_rows=[int(r["rows"]) for r in results],
+                slice_bytes=list(ship_bytes),
+                slice_elapsed=[round(s, 6) for s in slice_s])
             xsp.tags.update(fallbacks=fallbacks,
                             avoided=rec.avoided_parts,
-                            bytes=rec.bytes_shipped)
+                            bytes=rec.bytes_shipped,
+                            slice_skew=round(rec.slice_skew, 3))
         self.metrics.record(rec)
         we = getattr(getattr(node, "db", None), "wait_events", None)
         if we is not None:
             we.add("dtl exchange", elapsed)
-        if monitor is not None:
-            monitor.append((
-                f"DtlExchange(parts={nparts},fallback={fallbacks},"
-                f"avoided={rec.avoided_parts},"
-                f"bytes={rec.bytes_shipped})", rows_shipped))
+        if want_ops:
+            # estimate-vs-actual ledger for the DTL path: per-slice op
+            # rows (shipped back beside the data, ``spans``-style, as
+            # bare postorder int lists) sum across slices and q-error
+            # against the coordinator's estimates on its own copy of
+            # the partial plan — op names come from that copy too, so
+            # the reply pays rows-only wire cost.  The final-merge
+            # plan's own rows and the exchange summary follow.
+            # Positions renumber over the merged sequence.
+            per_op: list | None = None
+            for r in results:
+                ops = r.get("ops")
+                if ops is None:
+                    continue
+                if per_op is None:
+                    per_op = [0] * len(ops)
+                for j, cnt in enumerate(ops):
+                    if j < len(per_op):
+                        per_op[j] += int(cnt)
+            base = len(monitor)
+            if per_op:
+                nodes = pp.monitored_postorder(push.remote)
+                ests = [n.est_rows for n in nodes]
+                names = [type(n).__name__ for n in nodes]
+                for j, cnt in enumerate(per_op):
+                    est = ests[j] if j < len(ests) else None
+                    name = names[j] if j < len(names) else "Op"
+                    monitor.append({
+                        "op": "DtlPartial:" + name, "pos": 0,
+                        "est": est, "rows": cnt,
+                        "q_error": pp.q_error(est, cnt),
+                        "elapsed_s": 0.0})
+            monitor.extend(merge_mon or [])
+            monitor.append({
+                "op": (f"DtlExchange(parts={nparts},"
+                       f"fallback={fallbacks},"
+                       f"avoided={rec.avoided_parts},"
+                       f"bytes={rec.bytes_shipped})"),
+                "pos": 0, "est": None, "rows": rows_shipped,
+                "q_error": 0.0, "elapsed_s": elapsed})
+            for k in range(base, len(monitor)):
+                monitor[k]["pos"] = k
         return out

@@ -596,7 +596,7 @@ def _px_compiled(plan_key, holder, mesh, axis, ndev, factor, table_names):
                     ndev, cap, axis)
                 diag.push("px_exchange_overflow", s_ovf)
             total_ovf = jnp.zeros((), dtype=jnp.int64)
-            for _name, v in entries:
+            for _name, v, _cap in entries:
                 total_ovf = total_ovf + jnp.asarray(v, dtype=jnp.int64)
         return rel, jax.lax.psum(total_ovf, axis)
 
@@ -663,10 +663,18 @@ def _execute_distributed(plan, tables, mesh, axis, ndev, budget_factor,
     # themselves would identity-compare and defeat the executable cache
     aff_key = tuple(sorted((t, tuple(c)) for t, c in affinity.items()))
     cache_key = (plan.fingerprint(), aff_key)
+    misses0 = _px_compiled.cache_info().misses
     run = _px_compiled(
         cache_key,
         _Holder(droot, partial_specs, elide, dist_sort, cache_key),
         mesh, axis, ndev, budget_factor, tuple(sorted(needed)))
+    if _px_compiled.cache_info().misses > misses0:
+        # a fresh shard_map program traces+compiles on first dispatch:
+        # mark the statement so the plan-regression watchdog excludes
+        # this compile-inflated latency sample (exec/plan.py contract)
+        from oceanbase_tpu.exec.plan import mark_compiled
+
+        mark_compiled()
     out, overflow = run(sharded)
     # do NOT sync on the overflow scalar here: an int() at this point
     # parks the host mid-pipeline while the gather/merge/top-chain work

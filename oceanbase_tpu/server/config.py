@@ -71,7 +71,34 @@ DEF("enable_sql_spill", True, "bool",
     "route over-budget sorts/joins/group-bys through the temp-file "
     "spill tier instead of failing on CapacityOverflow")
 DEF("enable_sql_plan_monitor", True, "bool",
-    "collect per-operator row counts/timings (≙ sql_plan_monitor)")
+    "collect per-operator row counts/timings (≙ sql_plan_monitor); an "
+    "explicit EXPLAIN ANALYZE forces collection for its own statement "
+    "regardless")
+DEF("plan_monitor_sample_every", 16, "int",
+    "per-plan ledger sampling: the first executions of a logical plan "
+    "always collect per-operator rows, then every Nth (1 = collect "
+    "every execution); unsampled executions run the same monitored "
+    "executable but skip the host transfer and ledger record — "
+    "hot-reloadable via ALTER SYSTEM SET", _pos)
+DEF("enable_plan_feedback", True, "bool",
+    "cardinality feedback (gv$plan_feedback): monitored executions "
+    "record observed per-operator rows per logical plan hash; binds "
+    "consult the store to correct out_capacity, and CapacityOverflow "
+    "retries jump straight to the reported budget instead of riding "
+    "the blind 4x ladder — hot-reloadable via ALTER SYSTEM SET")
+DEF("plan_regress_threshold", 2.0, "float",
+    "plan-regression watchdog: a plan whose latency EWMA exceeds its "
+    "frozen warmup baseline by this factor is flagged regressed in "
+    "gv$plan_history — hot-reloadable via ALTER SYSTEM SET (each "
+    "execution re-reads it)", lambda v: v >= 1.0)
+DEF("plan_feedback_entries", 2048, "int",
+    "bounded gv$plan_feedback store: logical plan hashes kept (LRU); "
+    "takes effect for new Database instances (ring size is bound at "
+    "boot)", _pos)
+DEF("plan_history_entries", 1024, "int",
+    "bounded gv$plan_history store: logical plan hashes kept (LRU); "
+    "takes effect for new Database instances (ring size is bound at "
+    "boot)", _pos)
 DEF("enable_plan_cache", True, "bool",
     "cache bound physical plans keyed by parameterized SQL text")
 DEF("plan_cache_mem_limit", 512 << 20, "cap",

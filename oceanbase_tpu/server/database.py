@@ -23,6 +23,8 @@ from typing import Optional
 from oceanbase_tpu.server.config import Config
 from oceanbase_tpu.server.monitor import (
     AshSampler,
+    PlanFeedback,
+    PlanHistory,
     PlanMonitor,
     SqlAudit,
     WaitEvents,
@@ -56,6 +58,13 @@ class Database:
         # observability (cluster-wide)
         self.audit = SqlAudit(int(self.config["sql_audit_queue_size"]))
         self.plan_monitor = PlanMonitor()
+        # plan-quality plane: cardinality feedback + regression watchdog
+        # (gv$plan_feedback / gv$plan_history; sql/session.py wires them
+        # into bind + the CapacityOverflow retry ladder)
+        self.plan_feedback = PlanFeedback(
+            int(self.config["plan_feedback_entries"]))
+        self.plan_history = PlanHistory(
+            int(self.config["plan_history_entries"]))
         # full-link trace ring (gv$trace / SHOW TRACE; server/trace.py)
         self.trace_registry = TraceRegistry(
             int(self.config["trace_ring_spans"]))

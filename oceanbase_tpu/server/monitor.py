@@ -67,24 +67,267 @@ class SqlAudit:
             return len(self._ring)
 
 
+@dataclass
+class PlanMonitorRecord:
+    """One monitored execution (≙ a gv$sql_plan_monitor row group).
+
+    ``op_stats`` is the estimate-vs-actual ledger: one dict per operator
+    in executor postorder with op / pos / est / rows / q_error /
+    elapsed_s (exec/plan.py builds them at the result boundary) plus
+    optional per-path extras (spill_bytes on the spill tier).
+    ``logical_hash`` is the capacity-insensitive plan digest
+    (exec/plan.py::logical_hash) joining gv$plan_feedback and
+    gv$plan_history; ``retries`` counts the CapacityOverflow re-plans
+    this execution paid.
+    """
+
+    ts: float                  # wall clock (record timestamp)
+    plan_hash: str             # fingerprint digest (capacity-sensitive)
+    op_stats: list             # [{op, pos, est, rows, q_error, ...}]
+    total_s: float             # monotonic delta (step-proof)
+    logical_hash: str = ""     # gv$plan_feedback / gv$plan_history key
+    retries: int = 0           # CapacityOverflow re-plans before success
+    spill_bytes: int = 0       # temp-file bytes when the spill tier ran
+    path: str = "serial"       # serial | spill | px | dtl
+
+
 class PlanMonitor:
     """Plan-level + per-operator stats for recent executions.
 
     ``record`` stamps wall time as the row's record timestamp; the
     ``total_s`` the caller passes must be a ``time.monotonic()`` delta.
+
+    Collection is per-plan SAMPLED (``should_record``): the first
+    ``SAMPLE_WARMUP`` executions of a logical plan always collect, then
+    every ``plan_monitor_sample_every``-th — identical executions of one
+    plan carry redundant ledger rows.  An unsampled execution still runs
+    the SAME monitored executable (the variant is part of the compile
+    key; alternating it would double each plan's XLA trace count) but
+    skips the per-op host transfer and the ledger record, so
+    steady-state hot loops pay the host-side monitoring overhead a
+    handful of times, not per query (how the <=2%
+    scripts/planqual_bench.py contract is met).  EXPLAIN ANALYZE
+    bypasses sampling (it builds its own monitor list).
     """
+
+    SAMPLE_WARMUP = 8      # first executions of a plan always collect
+    _SEEN_MAX = 16384      # counter-map bound (coarse reset, not LRU)
 
     def __init__(self, capacity: int = 1000):
         self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._seen: dict[str, int] = {}
         self._lock = threading.Lock()
 
-    def record(self, plan_hash: str, op_stats: list, total_s: float):
+    def should_record(self, logical_hash: str, every: int) -> bool:
+        """Count one execution of ``logical_hash``; -> collect this one?
+        ``every`` <= 1 disables sampling (always collect)."""
+        if every <= 1 or not logical_hash:
+            return True
         with self._lock:
-            self._ring.append((time.time(), plan_hash, op_stats, total_s))
+            if len(self._seen) >= self._SEEN_MAX:
+                self._seen.clear()  # plans re-enter warmup; bounded
+            c = self._seen.get(logical_hash, 0) + 1
+            self._seen[logical_hash] = c
+        return c <= self.SAMPLE_WARMUP or c % every == 0
+
+    def record(self, plan_hash: str, op_stats: list, total_s: float,
+               logical_hash: str = "", retries: int = 0,
+               spill_bytes: int = 0, path: str = "serial"):
+        rec = PlanMonitorRecord(time.time(), plan_hash, op_stats,
+                                total_s, logical_hash, retries,
+                                spill_bytes, path)
+        with self._lock:
+            self._ring.append(rec)
 
     def recent(self, n: int = 50):
         with self._lock:
             return _tail(self._ring, n)
+
+
+class PlanFeedback:
+    """Cardinality-feedback store (≙ the SPM/feedback loop OceanBase
+    runs through plan evolution): per (logical plan hash x operator
+    postorder position), the MAX observed output rows beside the
+    estimate that was in force — the session consults it at bind time
+    (sql/optimizer.py::apply_feedback) so a known-underestimated
+    operator starts at the observed capacity bucket instead of riding
+    the CapacityOverflow retry ladder again.
+
+    Bounded: an LRU over logical hashes (``capacity`` entries); a hash
+    evicted under pressure simply re-learns on its next misestimate.
+
+    Only UNDERESTIMATES at or beyond ``MIN_Q`` are stored: a correction
+    exists to raise a too-small out_capacity, so well-estimated (or
+    over-estimated) operators teach nothing — and keeping them out means
+    a healthy plan's bind never pays the corrections walk at all.
+    """
+
+    MIN_Q = 2.0   # observed/est factor before a row is worth storing
+
+    def __init__(self, capacity: int = 2048):
+        self.capacity = int(capacity)
+        #: lhash -> {pos: {"op", "est", "rows", "q_error", "hits",
+        #:                 "last_ts"}}
+        self._store: collections.OrderedDict[str, dict] = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def observe(self, logical_hash: str, op_rows: list):
+        """Fold one monitored execution's ledger rows in (move-to-front
+        LRU touch); only underestimated rows teach anything."""
+        if not logical_hash or not op_rows:
+            return
+        teach = [r for r in op_rows
+                 if r.get("pos") is not None
+                 and r.get("est") is not None
+                 and r["rows"] > r["est"]
+                 and float(r.get("q_error", 0.0)) >= self.MIN_Q]
+        if not teach:
+            return
+        with self._lock:
+            ent = self._store.get(logical_hash)
+            if ent is None:
+                while len(self._store) >= max(self.capacity, 1):
+                    self._store.popitem(last=False)
+                ent = self._store[logical_hash] = {}
+            else:
+                self._store.move_to_end(logical_hash)
+            now = time.time()
+            for r in teach:
+                pos = r.get("pos")
+                cur = ent.get(pos)
+                if cur is None:
+                    cur = ent[pos] = {
+                        "op": r["op"], "est": r.get("est"),
+                        "rows": int(r["rows"]),
+                        "q_error": float(r.get("q_error", 0.0)),
+                        "hits": 0, "last_ts": now}
+                else:
+                    # MAX observed rows: capacity corrections must cover
+                    # the worst run seen, not chase the latest one — and
+                    # est/q_error stay the pair from THAT run, so the
+                    # stored (est, rows, q_error) triple is one coherent
+                    # observation, not a mix of three executions
+                    if int(r["rows"]) > cur["rows"]:
+                        cur["rows"] = int(r["rows"])
+                        cur["est"] = r.get("est")
+                        cur["q_error"] = float(r.get("q_error", 0.0))
+                    cur["last_ts"] = now
+
+    def corrections(self, logical_hash: str) -> dict:
+        """-> {postorder position: (op_name, max observed rows)} for
+        apply_feedback; {} when the hash has never been observed."""
+        with self._lock:
+            ent = self._store.get(logical_hash)
+            if not ent:
+                return {}
+            self._store.move_to_end(logical_hash)
+            out = {}
+            for pos, cur in ent.items():
+                cur["hits"] += 1
+                out[pos] = (cur["op"], cur["rows"])
+            return out
+
+    def rows(self) -> list:
+        """Flat gv$plan_feedback rows."""
+        with self._lock:
+            out = []
+            for lhash, ent in self._store.items():
+                for pos, cur in sorted(ent.items()):
+                    out.append({"logical_hash": lhash, "pos": pos,
+                                **cur})
+            return out
+
+    def __len__(self):
+        with self._lock:
+            return len(self._store)
+
+
+class PlanHistory:
+    """Plan-regression watchdog (≙ spm plan baselines + the SQL
+    performance-regression checks): per logical plan hash, a log-bucket
+    latency histogram plus an EWMA; the first ``WARMUP`` executions
+    freeze a baseline, after which an EWMA beyond
+    ``baseline * threshold`` flags the plan ``regressed`` in
+    gv$plan_history (the flag clears when latency recovers)."""
+
+    WARMUP = 5         # executions before the baseline freezes
+    ALPHA = 0.3        # EWMA weight of the newest sample
+
+    def __init__(self, capacity: int = 1024):
+        from oceanbase_tpu.server.metrics import Histogram
+
+        self._hist_cls = Histogram
+        self.capacity = int(capacity)
+        #: lhash -> {"hist", "ewma", "baseline_s", "executions",
+        #:           "regressed", "regress_count", "last_ts", "last_s"}
+        self._store: collections.OrderedDict[str, dict] = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def record(self, logical_hash: str, elapsed_s: float,
+               threshold: float) -> bool:
+        """Fold one execution in; -> True when this sample TRANSITIONED
+        the plan into the regressed state (the caller counts it)."""
+        if not logical_hash:
+            return False
+        elapsed_s = float(elapsed_s)
+        with self._lock:
+            ent = self._store.get(logical_hash)
+            if ent is None:
+                while len(self._store) >= max(self.capacity, 1):
+                    self._store.popitem(last=False)
+                ent = self._store[logical_hash] = {
+                    "hist": self._hist_cls(), "ewma": elapsed_s,
+                    "baseline_s": 0.0, "executions": 0,
+                    "regressed": False, "regress_count": 0,
+                    "last_ts": 0.0, "last_s": 0.0}
+            else:
+                self._store.move_to_end(logical_hash)
+            ent["hist"].observe(elapsed_s)
+            ent["executions"] += 1
+            ent["ewma"] = (self.ALPHA * elapsed_s
+                           + (1.0 - self.ALPHA) * ent["ewma"])
+            ent["last_ts"] = time.time()
+            ent["last_s"] = elapsed_s
+            if ent["executions"] == self.WARMUP:
+                # freeze the baseline at the warmup EWMA (p95-adjacent
+                # for a stable plan; a plan that regresses DURING warmup
+                # simply bakes the slow latency in and stays unflagged —
+                # the histogram still shows the shift)
+                ent["baseline_s"] = ent["ewma"]
+            transitioned = False
+            if ent["executions"] > self.WARMUP and ent["baseline_s"] > 0:
+                now_regressed = (
+                    ent["ewma"] > ent["baseline_s"] * float(threshold))
+                if now_regressed and not ent["regressed"]:
+                    ent["regress_count"] += 1
+                    transitioned = True
+                ent["regressed"] = now_regressed
+            return transitioned
+
+    def rows(self) -> list:
+        """Flat gv$plan_history rows (percentiles from the bucket
+        counts, never stored samples)."""
+        from oceanbase_tpu.server.metrics import hist_stats
+
+        with self._lock:
+            out = []
+            for lhash, ent in self._store.items():
+                st = hist_stats(ent["hist"])
+                out.append({
+                    "logical_hash": lhash,
+                    "executions": ent["executions"],
+                    "ewma_s": ent["ewma"],
+                    "baseline_s": ent["baseline_s"],
+                    "last_s": ent["last_s"],
+                    "last_ts": ent["last_ts"],
+                    "min_s": st["min"], "max_s": st["max"],
+                    "p50_s": st["p50"], "p95_s": st["p95"],
+                    "p99_s": st["p99"],
+                    "regressed": ent["regressed"],
+                    "regress_count": ent["regress_count"]})
+            return out
 
 
 class WaitEvents:

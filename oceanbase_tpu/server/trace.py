@@ -100,6 +100,10 @@ class TraceCtx:
         with self._lock:
             self.spans.append(sp)
 
+    def add_many(self, sps: list[Span]):
+        with self._lock:
+            self.spans.extend(sps)
+
     def snapshot(self) -> list[Span]:
         with self._lock:
             return list(self.spans)
@@ -249,6 +253,21 @@ def add_span(name: str, elapsed_s: float, **tags):
         return
     ctx.add(Span(ctx.trace_id, ctx.next_id(), getattr(_tls, "parent", 0),
                  ctx.node, name, time.time(), float(elapsed_s), tags))
+
+
+def add_spans(items: list):
+    """Bulk add_span: ``items`` is ``[(name, elapsed_s, tags_dict)]``.
+    One wall-clock read and one context lock for the whole batch — the
+    per-operator ledger emits its spans through here so a monitored
+    execution pays O(1) locking, not O(operators)."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None or not items:
+        return
+    parent = getattr(_tls, "parent", 0)
+    now = time.time()
+    ctx.add_many([
+        Span(ctx.trace_id, ctx.next_id(), parent, ctx.node, nm, now,
+             float(el), tg) for nm, el, tg in items])
 
 
 # -- manual begin/end (rpc client wraps a retry loop, not a with-block) ----

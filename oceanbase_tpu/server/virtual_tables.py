@@ -31,6 +31,11 @@ class VirtualTables:
         return {
             "gv$sql_audit": self.sql_audit,
             "gv$plan_monitor": self.plan_monitor,
+            # canonical name for the estimate-vs-actual ledger (the
+            # reference's view name); gv$plan_monitor stays as an alias
+            "gv$sql_plan_monitor": self.plan_monitor,
+            "gv$plan_feedback": self.plan_feedback,
+            "gv$plan_history": self.plan_history,
             "gv$plan_cache": self.plan_cache,
             "gv$px_exchange": self.px_exchange,
             "gv$cluster_health": self.cluster_health,
@@ -110,16 +115,103 @@ class VirtualTables:
         }
 
     def plan_monitor(self):
+        """Estimate-vs-actual cardinality ledger (≙ gv$sql_plan_monitor):
+        one row per operator per monitored execution — the optimizer's
+        est_rows beside the measured output rows, their q-error, and the
+        execution's capacity retries / spill bytes / path."""
         rows = []
-        for ts, phash, op_stats, total_s in self.db.plan_monitor.recent(200):
-            for op, cnt in op_stats:
-                rows.append((ts, phash, op, cnt, total_s))
+        for rec in self.db.plan_monitor.recent(200):
+            for r in rec.op_stats:
+                rows.append((rec.ts, rec.plan_hash, rec.logical_hash,
+                             r.get("pos", 0), r["op"],
+                             -1 if r.get("est") is None else r["est"],
+                             r["rows"], r.get("q_error", 0.0),
+                             r.get("elapsed_s", 0.0), rec.retries,
+                             r.get("spill_bytes", rec.spill_bytes),
+                             rec.path, rec.total_s))
         return {
             "ts": np.array([r[0] for r in rows], np.float64),
             "plan_hash": _obj(r[1] for r in rows),
-            "operator": _obj(r[2] for r in rows),
-            "output_rows": np.array([r[3] for r in rows], np.int64),
-            "plan_elapsed_s": np.array([r[4] for r in rows], np.float64),
+            "logical_hash": _obj(r[2] for r in rows),
+            "op_pos": np.array([r[3] for r in rows], np.int64),
+            "operator": _obj(r[4] for r in rows),
+            # -1 = the binder had no estimate for this operator
+            "est_rows": np.array([r[5] for r in rows], np.int64),
+            "output_rows": np.array([r[6] for r in rows], np.int64),
+            "q_error": np.array([r[7] for r in rows], np.float64),
+            "op_elapsed_s": np.array([r[8] for r in rows], np.float64),
+            "capacity_retries": np.array([r[9] for r in rows], np.int64),
+            "spill_bytes": np.array([r[10] for r in rows], np.int64),
+            "path": _obj(r[11] for r in rows),
+            "plan_elapsed_s": np.array([r[12] for r in rows],
+                                       np.float64),
+        }
+
+    def plan_feedback(self):
+        """Cardinality-feedback store (server/monitor.py::PlanFeedback)
+        plus ANALYZE's string-column MCV lists in the same joinable
+        shape: ``kind='card'`` rows key on (logical_hash, op_pos) like
+        gv$sql_plan_monitor; ``kind='mcv'`` rows key on table.column in
+        the operator column (detail carries the top values/fractions the
+        binder's equality selectivity reads)."""
+        import json as _json
+
+        rows = []
+        fb = getattr(self.db, "plan_feedback", None)
+        for r in (fb.rows() if fb is not None else []):
+            rows.append(("card", r["logical_hash"], r["pos"], r["op"],
+                         -1 if r.get("est") is None else r["est"],
+                         r["rows"], r.get("q_error", 0.0),
+                         r.get("hits", 0), r.get("last_ts", 0.0), ""))
+        for tname, tenant in self.db.tenants.items():
+            for name, ts in tenant.engine.tables.items():
+                for col, (vals, freqs) in sorted(
+                        getattr(ts.tdef, "mcv", {}).items()):
+                    rows.append((
+                        "mcv", "", -1, f"{name}.{col}",
+                        ts.tdef.ndv.get(col, -1), len(vals),
+                        0.0, 0, 0.0,
+                        _json.dumps({"values": vals,
+                                     "fractions": [round(f, 6)
+                                                   for f in freqs]})))
+        return {
+            "kind": _obj(r[0] for r in rows),
+            "logical_hash": _obj(r[1] for r in rows),
+            "op_pos": np.array([r[2] for r in rows], np.int64),
+            "operator": _obj(r[3] for r in rows),
+            "est_rows": np.array([r[4] for r in rows], np.int64),
+            "observed_rows": np.array([r[5] for r in rows], np.int64),
+            "q_error": np.array([r[6] for r in rows], np.float64),
+            "hits": np.array([r[7] for r in rows], np.int64),
+            "last_ts": np.array([r[8] for r in rows], np.float64),
+            "detail": _obj(r[9] for r in rows),
+        }
+
+    def plan_history(self):
+        """Plan-regression watchdog (server/monitor.py::PlanHistory):
+        per logical plan hash, the latency distribution + EWMA against
+        the frozen warmup baseline, flagged when the EWMA exceeds
+        baseline * plan_regress_threshold."""
+        ph = getattr(self.db, "plan_history", None)
+        rows = ph.rows() if ph is not None else []
+        return {
+            "logical_hash": _obj(r["logical_hash"] for r in rows),
+            "executions": np.array([r["executions"] for r in rows],
+                                   np.int64),
+            "ewma_s": np.array([r["ewma_s"] for r in rows], np.float64),
+            "baseline_s": np.array([r["baseline_s"] for r in rows],
+                                   np.float64),
+            "last_s": np.array([r["last_s"] for r in rows], np.float64),
+            "last_ts": np.array([r["last_ts"] for r in rows],
+                                np.float64),
+            "min_s": np.array([r["min_s"] for r in rows], np.float64),
+            "max_s": np.array([r["max_s"] for r in rows], np.float64),
+            "p50_s": np.array([r["p50_s"] for r in rows], np.float64),
+            "p95_s": np.array([r["p95_s"] for r in rows], np.float64),
+            "p99_s": np.array([r["p99_s"] for r in rows], np.float64),
+            "regressed": np.array([bool(r["regressed"]) for r in rows]),
+            "regress_count": np.array([r["regress_count"] for r in rows],
+                                      np.int64),
         }
 
     def plan_cache(self):
@@ -162,7 +254,10 @@ class VirtualTables:
 
     def px_exchange(self):
         """DTL exchange activity: plan-pushdown vs snapshot-pull events
-        with their wire cost (≙ gv$px_dtl traffic stats; px/dtl.py)."""
+        with their wire cost and per-slice row/byte/elapsed attribution
+        (≙ gv$px_dtl traffic stats; px/dtl.py)."""
+        import json as _json
+
         m = getattr(self.db, "dtl_metrics", None)
         recs = m.recent(1000) if m is not None else []
         return {
@@ -183,6 +278,23 @@ class VirtualTables:
                 np.int64),
             "elapsed_s": np.array([r.elapsed_s for r in recs],
                                   np.float64),
+            # per-slice attribution: output-row balance across the
+            # exchange's slices (skew = max/mean; 0.0 = no slice data)
+            "max_slice_rows": np.array(
+                [max(r.slice_rows) if getattr(r, "slice_rows", None)
+                 else 0 for r in recs], np.int64),
+            "mean_slice_rows": np.array(
+                [(sum(r.slice_rows) / len(r.slice_rows))
+                 if getattr(r, "slice_rows", None) else 0.0
+                 for r in recs], np.float64),
+            "slice_skew": np.array(
+                [getattr(r, "slice_skew", 0.0) for r in recs],
+                np.float64),
+            "slices": _obj(_json.dumps(
+                {"rows": r.slice_rows, "bytes": r.slice_bytes,
+                 "elapsed_s": r.slice_elapsed})
+                if getattr(r, "slice_rows", None) else ""
+                for r in recs),
         }
 
     def cluster_health(self):

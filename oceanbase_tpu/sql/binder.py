@@ -110,6 +110,8 @@ class Fragment:
     ndv: dict = field(default_factory=dict)  # colid -> distinct-value est
     # colid -> (equi-height edges, null_frac, SqlType) from ANALYZE
     hist: dict = field(default_factory=dict)
+    # colid -> (mcv values, frequency fractions) from ANALYZE (strings)
+    mcv: dict = field(default_factory=dict)
 
     def __post_init__(self):
         if not self.colids:
@@ -177,6 +179,12 @@ class Binder:
         if stmt.post_limit is not None:
             plan = pp.Limit(plan, stmt.post_limit, stmt.post_offset)
             est = min(est, stmt.post_limit)
+        if outer is None:
+            # top-level bind: fill est_rows on every node the binder did
+            # not annotate directly, so each gv$sql_plan_monitor row has
+            # an estimate to q-error against (est_rows is metadata —
+            # repr/compare-excluded, so fingerprints are unaffected)
+            plan = pp.propagate_estimates(plan)
         return plan, outputs, est
 
     @staticmethod
@@ -428,6 +436,7 @@ class Binder:
         unique = []
         ndv = {}
         hist = {}
+        mcv = {}
         for c in tdef.columns:
             cid = fresh(f"{alias}_{c.name}")
             rename[c.name] = cid
@@ -438,13 +447,16 @@ class Binder:
             if c.name in getattr(tdef, "histograms", {}):
                 edges, nf = tdef.histograms[c.name]
                 hist[cid] = (edges, nf, c.dtype)
+            if c.name in getattr(tdef, "mcv", {}):
+                mcv[cid] = tdef.mcv[c.name]
         if len(tdef.primary_key) == 1:
             unique.append(rename[tdef.primary_key[0]])
             ndv[rename[tdef.primary_key[0]]] = max(tdef.row_count, 1)
         qb.fragments.append(Fragment(
-            pp.TableScan(name, rename=rename),
+            pp.TableScan(name, rename=rename,
+                         est_rows=max(tdef.row_count, 1)),
             cols, max(tdef.row_count, 1), frozenset(unique), ndv=ndv,
-            hist=hist,
+            hist=hist, mcv=mcv,
         ))
 
     def _bind_view(self, name: str, vdef: dict, tref, qb, scope):
@@ -527,16 +539,21 @@ class Binder:
             raise BindError(
                 "FULL OUTER JOIN supports equi-join ON conditions only")
         for p in rpreds:
-            rf = Fragment(pp.Filter(rf.plan, p), rf.cols,
+            rf = Fragment(pp.Filter(rf.plan, p,
+                                    est_rows=max(1, rf.est_rows // 3)),
+                          rf.cols,
                           max(1, rf.est_rows // 3), rf.unique_cols,
-                          colids=rf.colids, ndv=rf.ndv)
+                          colids=rf.colids, ndv=rf.ndv,
+                          hist=rf.hist, mcv=rf.mcv)
         lkeys = [e[0] for e in eqs]
         rkeys = [e[1] for e in eqs]
         cap = _pow2(int((lf.est_rows + (rf.est_rows
                                         if how == "full" else 0))
                         * 1.5) + 16)
         plan = pp.HashJoin(lf.plan, rf.plan, lkeys, rkeys, how=how,
-                           out_capacity=cap)
+                           out_capacity=cap,
+                           est_rows=max(1, lf.est_rows + (
+                               rf.est_rows if how == "full" else 0)))
         for p in lpreds + residual:
             # ON predicates on the left side of a LEFT JOIN semantically
             # only nullify matches; approximate by post-filtering matched
@@ -552,7 +569,8 @@ class Binder:
             frozenset() if how == "full" else lf.unique_cols,
             colids=lf.colids | rf.colids,
             ndv={**lf.ndv, **rf.ndv},
-            hist={**lf.hist, **rf.hist}))
+            hist={**lf.hist, **rf.hist},
+            mcv={**lf.mcv, **rf.mcv}))
 
     def _bind_side(self, tref, scope: Scope) -> Fragment:
         """Bind one side of an eager (outer) join into a single fragment."""
@@ -571,14 +589,16 @@ class Binder:
         unique = frozenset()
         ndv = {}
         hist = {}
+        mcv = {}
         for f in sub_qb.fragments:
             cols.update(f.cols)
             colids |= f.colids
             unique |= f.unique_cols
             ndv.update(f.ndv)
             hist.update(f.hist)
+            mcv.update(f.mcv)
         return Fragment(plan, cols, est, unique, colids=colids, ndv=ndv,
-                        hist=hist)
+                        hist=hist, mcv=mcv)
 
     @staticmethod
     def _col_in(frag: Fragment, name: str) -> str:
@@ -667,11 +687,13 @@ class Binder:
             if homes:
                 i = homes[0]
                 f = qb.fragments[i]
+                new_est = max(1, int(f.est_rows * _selectivity(
+                    bound, f.hist, f.mcv, f.ndv)))
                 qb.fragments[i] = Fragment(
-                    pp.Filter(f.plan, bound), f.cols,
-                    max(1, int(f.est_rows * _selectivity(bound, f.hist))),
+                    pp.Filter(f.plan, bound, est_rows=new_est), f.cols,
+                    new_est,
                     f.unique_cols, colids=f.colids, ndv=f.ndv,
-                    hist=f.hist,
+                    hist=f.hist, mcv=f.mcv,
                 )
             else:
                 qb.post_preds.append(bound)  # constant predicate
@@ -734,10 +756,11 @@ class Binder:
         how = "anti" if anti else "semi"
         cap = _pow2(int(f.est_rows * 2) + 16)
         rkeys = [ir.col(c) for c in rhs_cids]
+        est = max(1, f.est_rows // (2 if not anti else 3))
         if residual:
             new_plan = pp.SemiJoinResidual(
                 f.plan, in_plan, lhs_exprs, rkeys, residual,
-                anti=anti, out_capacity=cap,
+                anti=anti, out_capacity=cap, est_rows=est,
             )
         else:
             # explicit capacity: inexact (multi-key) semi/anti joins expand
@@ -745,10 +768,10 @@ class Binder:
             # non-None out_capacity is reachable by scale_capacities on
             # CapacityOverflow retries
             new_plan = pp.HashJoin(f.plan, in_plan, lhs_exprs, rkeys,
-                                   how=how, out_capacity=cap)
-        est = max(1, f.est_rows // (2 if not anti else 3))
+                                   how=how, out_capacity=cap, est_rows=est)
         qb.fragments[i] = Fragment(new_plan, f.cols, est, f.unique_cols,
-                                   colids=f.colids, ndv=f.ndv)
+                                   colids=f.colids, ndv=f.ndv,
+                                   hist=f.hist, mcv=f.mcv)
 
     def _rewrite_scalar_cmp(self, conj, sub, other_side, sub_on_left, qb,
                             scope):
@@ -840,10 +863,11 @@ class Binder:
             n_keys_est = min(n_keys_est, 1 << 40)  # overflow guard
         out_cap = _pow2(min(est, max(64, min(n_keys_est, est))))
         if key_map:
-            plan = pp.GroupBy(plan, key_map, agg_specs, out_capacity=out_cap)
+            plan = pp.GroupBy(plan, key_map, agg_specs, out_capacity=out_cap,
+                              est_rows=max(1, min(n_keys_est, est)))
             est = min(est, out_cap)
         else:
-            plan = pp.ScalarAgg(plan, agg_specs)
+            plan = pp.ScalarAgg(plan, agg_specs, est_rows=1)
             est = 1
         return plan, new_items, having_bound, est, replace
 
@@ -1048,10 +1072,11 @@ class _CorrelationCollector:
             new_items = [(replace(bound), name) for bound, name in items]
             if key_map:
                 cap = _pow2(max(64, min(est, 1 << 22)))
-                plan = pp.GroupBy(plan, key_map, agg_specs, out_capacity=cap)
+                plan = pp.GroupBy(plan, key_map, agg_specs, out_capacity=cap,
+                                  est_rows=max(1, min(est, cap)))
                 est = min(est, cap)
             else:
-                plan = pp.ScalarAgg(plan, agg_specs)
+                plan = pp.ScalarAgg(plan, agg_specs, est_rows=1)
                 est = 1
             if inner.having is not None:
                 hb = replace(b.bind_expr(inner.having, scope, allow_agg=True))
@@ -1277,13 +1302,51 @@ def _hist_selectivity(pred: ir.Cmp, hist: dict):
     return float(min(max(frac * (1.0 - null_frac), 0.001), 1.0))
 
 
-def _selectivity(pred: ir.Expr, hist: dict | None = None) -> float:
+def _mcv_selectivity(col: str, value, op: str, mcv: dict,
+                     ndv: dict) -> float | None:
+    """Equality/inequality selectivity for a string literal from the
+    ANALYZE-built most-common-values list (≙ ObOptSelectivity frequency
+    histogram).  None when the column has no MCV entry."""
+    entry = (mcv or {}).get(col)
+    if entry is None or not isinstance(value, str):
+        return None
+    values, freqs = entry
+    covered = sum(freqs)
+    try:
+        f = freqs[values.index(value)]
+    except ValueError:
+        # not a common value: spread the residual mass over the
+        # distinct values the MCV list does not cover
+        n = (ndv or {}).get(col)
+        rest = max((n or len(values) * 10) - len(values), 1)
+        f = max(0.0, 1.0 - covered) / rest
+    if op == "!=":
+        f = 1.0 - f
+    return float(min(max(f, 0.0001), 1.0))
+
+
+def _selectivity(pred: ir.Expr, hist: dict | None = None,
+                 mcv: dict | None = None,
+                 ndv: dict | None = None) -> float:
     if isinstance(pred, ir.Cmp):
         hs = _hist_selectivity(pred, hist)
         if hs is not None:
             return hs
+        if pred.op in ("=", "!="):
+            l, r = pred.left, pred.right
+            if isinstance(l, ir.Literal) and isinstance(r, ir.ColumnRef):
+                l, r = r, l
+            if isinstance(l, ir.ColumnRef) and isinstance(r, ir.Literal):
+                ms = _mcv_selectivity(l.name, r.value, pred.op, mcv, ndv)
+                if ms is not None:
+                    return ms
         return 0.1 if pred.op == "=" else 0.4
     if isinstance(pred, ir.InList):
+        if isinstance(pred.arg, ir.ColumnRef) and not pred.negated:
+            per = [_mcv_selectivity(pred.arg.name, v.value, "=", mcv, ndv)
+                   for v in pred.values if isinstance(v, ir.Literal)]
+            if per and all(p is not None for p in per):
+                return min(0.9, sum(per))
         return min(0.9, 0.1 * max(len(pred.values), 1))
     if isinstance(pred, ir.Like):
         return 0.1
@@ -1291,9 +1354,10 @@ def _selectivity(pred: ir.Expr, hist: dict | None = None) -> float:
         s = 1.0
         if pred.op == "and":
             for a in pred.args:
-                s *= _selectivity(a, hist)
+                s *= _selectivity(a, hist, mcv, ndv)
         else:
-            s = min(1.0, sum(_selectivity(a, hist) for a in pred.args))
+            s = min(1.0, sum(_selectivity(a, hist, mcv, ndv)
+                             for a in pred.args))
         return s
     return 0.5
 
@@ -1353,10 +1417,13 @@ def _bind_conjunct_bound(self: Binder, bound: ir.Expr, qb: QueryBlock):
     if len(homes) == 1:
         i = homes[0]
         f = qb.fragments[i]
+        new_est = max(1, int(f.est_rows * _selectivity(
+            bound, f.hist, f.mcv, f.ndv)))
         qb.fragments[i] = Fragment(
-            pp.Filter(f.plan, bound), f.cols,
-            max(1, int(f.est_rows * _selectivity(bound, f.hist))),
+            pp.Filter(f.plan, bound, est_rows=new_est), f.cols,
+            new_est,
             f.unique_cols, colids=f.colids, ndv=f.ndv, hist=f.hist,
+            mcv=f.mcv,
         )
     else:
         qb.post_preds.append(bound)

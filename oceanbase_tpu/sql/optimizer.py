@@ -96,7 +96,8 @@ def build_join_tree(qb, catalog, capacity_factor: float = 1.5):
         cap = _pow2(int(min(out_est, CAP_MAX) * capacity_factor) + 16)
         plan = pp.HashJoin(plan, f.plan,
                            [k[0] for k in keys], [k[1] for k in keys],
-                           how="inner", out_capacity=cap)
+                           how="inner", out_capacity=cap,
+                           est_rows=max(1, out_est))
         est = max(1, out_est)
         tree_ndv.update(f.ndv)
         joined.add(idx)
@@ -191,3 +192,77 @@ def scale_capacities(node: pp.PlanNode, factor: int) -> pp.PlanNode:
     if not updates:
         return node
     return dataclasses.replace(node, **updates)
+
+
+def overflow_jump_factor(drops: list, slack: float = 1.5) -> int:
+    """Capacity-scale factor that clears every overflowing lane in ONE
+    re-plan: each diagnostic lane reports (name, static_capacity,
+    rows_dropped), so the needed budget is capacity + dropped — jump
+    straight there (with slack) instead of riding the blind 4x ladder.
+    Returns a power-of-two factor >= 4 (lanes without a recorded
+    capacity fall back to the ladder step)."""
+    need = 4
+    for _name, cap, dropped in drops or []:
+        if not cap:
+            continue
+        want = (cap + dropped) * slack / cap
+        f = 4
+        while f < want and f < (CAP_MAX // max(cap, 1)):
+            f *= 4
+        need = max(need, f)
+    return need
+
+
+def apply_feedback(plan: pp.PlanNode, corrections: dict,
+                   slack: float = 1.5) -> tuple[pp.PlanNode, int]:
+    """Correct static budgets from observed cardinalities at bind time.
+
+    ``corrections`` maps MONITORED-postorder position -> (op_name,
+    observed_rows) from the gv$plan_feedback store (keyed by the plan's
+    logical hash, so capacity scaling does not orphan the entries; the
+    position space is exec/plan.py::monitored_postorder — pass-through
+    operators emit no ledger row).  A node whose out_capacity is below
+    the observed bucket starts at the bucket instead of re-riding the
+    CapacityOverflow retry ladder.  The op-name check guards against
+    postorder drift (e.g. the fused top-N path).
+    -> (plan, number of capacities raised)."""
+    import dataclasses
+
+    from oceanbase_tpu.exec.plan import monitored_op
+
+    counter = [0]
+    n_fixed = [0]
+
+    def walk(node, parent=None):
+        kids = {}
+        changed = False
+        for fname in ("child", "left", "right"):
+            if hasattr(node, fname):
+                old = getattr(node, fname)
+                nv = walk(old, node)
+                kids[fname] = nv
+                changed = changed or nv is not old
+        if hasattr(node, "inputs"):
+            nv_list = [walk(c, node) for c in node.inputs]
+            kids["inputs"] = nv_list
+            changed = changed or any(
+                a is not b for a, b in zip(nv_list, node.inputs))
+        hit = None
+        if monitored_op(node, parent):
+            hit = corrections.get(counter[0])
+            counter[0] += 1
+        updates = dict(kids) if changed else {}
+        if hit is not None:
+            op_name, rows = hit
+            if op_name == type(node).__name__ and \
+                    getattr(node, "out_capacity", None) is not None:
+                want = _pow2(int(rows * slack) + 16)
+                if want > node.out_capacity:
+                    updates["out_capacity"] = min(want, CAP_MAX)
+                    n_fixed[0] += 1
+        if not updates:
+            return node
+        return dataclasses.replace(node, **updates)
+
+    out = walk(plan)
+    return out, n_fixed[0]

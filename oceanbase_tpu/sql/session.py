@@ -770,11 +770,14 @@ class Session:
                 self._engine.minor_compact(table)
 
     HIST_BUCKETS = 64
+    MCV_K = 16  # most-common-values kept per string column
 
     def _analyze(self, stmt: ast.AnalyzeStmt) -> Result:
-        """Refresh optimizer stats for a table: row count, NDV, and
-        equi-height histograms for non-string columns
-        (≙ DBMS_STATS gather, src/share/stat/ob_opt_column_stat.h)."""
+        """Refresh optimizer stats for a table: row count, NDV,
+        equi-height histograms for non-string columns, and
+        most-common-values frequency lists for dict-encoded string
+        columns (≙ DBMS_STATS gather, src/share/stat/
+        ob_opt_column_stat.h top-k frequency histogram)."""
         td = self.catalog.table_def(stmt.table)
         rel = self.catalog.table_data(stmt.table)
         import numpy as _np
@@ -787,7 +790,24 @@ class Session:
             if col is None:
                 continue
             if col.sdict is not None:
-                td.ndv[c.name] = col.sdict.size
+                codes = _np.asarray(col.data)[mask]
+                if col.valid is not None:
+                    codes = codes[_np.asarray(col.valid)[mask]]
+                codes = codes[codes >= 0]
+                uniq, counts = _np.unique(codes, return_counts=True)
+                td.ndv[c.name] = max(int(len(uniq)), 1)
+                if len(uniq):
+                    # top-k by measured frequency: string-equality
+                    # selectivity reads this instead of the 0.1 guess
+                    order = _np.argsort(counts)[::-1][:self.MCV_K]
+                    total = max(int(counts.sum()), 1)
+                    td.mcv[c.name] = (
+                        [str(col.sdict.values[int(uniq[i])])
+                         for i in order],
+                        [float(counts[i]) / total for i in order],
+                    )
+                else:
+                    td.mcv.pop(c.name, None)
                 continue
             data = _np.asarray(col.data)[mask]
             if col.valid is not None:
@@ -1002,6 +1022,27 @@ class Session:
             else:
                 plan, outputs, _est = self._plan_select(stmt, params)
         self._last_compile_s = time.monotonic() - tb0
+        from oceanbase_tpu.exec.plan import logical_hash as _lhash_of
+        from oceanbase_tpu.sql.optimizer import apply_feedback
+
+        # cardinality feedback (gv$plan_feedback): a logical plan whose
+        # operators were observed bigger than their static budgets starts
+        # at the observed capacity bucket instead of re-riding the
+        # CapacityOverflow retry ladder (≙ plan evolution consulting
+        # measured stats).  Keyed by the capacity-insensitive hash so the
+        # corrected plan keeps matching its own history.
+        lhash = _lhash_of(plan) if self.db is not None else ""
+        feedback_on = (
+            self.db is not None
+            and getattr(self.db, "plan_feedback", None) is not None
+            and bool(self.db.config["enable_plan_feedback"]))
+        if feedback_on:
+            corr = self.db.plan_feedback.corrections(lhash)
+            if corr:
+                qmetrics.inc("plan.feedback_hits")
+                plan, n_fixed = apply_feedback(plan, corr)
+                if n_fixed:
+                    qmetrics.inc("plan.feedback_corrections", n_fixed)
         # estimate-driven spill route (≙ the SQL memory manager deciding
         # spill from work-area estimates BEFORE execution): over-budget
         # inputs never materialize whole on device
@@ -1028,12 +1069,28 @@ class Session:
 
         self._last_access_paths = {}
         monitor = None
+        mon_collect = True
         if self.db is not None and \
                 getattr(self.db, "plan_monitor", None) is not None and \
                 self.db.config["enable_sql_plan_monitor"]:
+            # sampled ledger collection: every execution runs the SAME
+            # monitored executable (the variant is part of the compile
+            # key — alternating it would double the plan's XLA trace
+            # count and break the shape-bucket amortization invariant);
+            # unsampled executions merely skip the host transfer and
+            # the ledger record
             monitor = []
+            mon_collect = self.db.plan_monitor.should_record(
+                lhash,
+                int(self.db.config["plan_monitor_sample_every"]))
         dop = self._px_dop()
         factor = 1
+        from oceanbase_tpu.exec.plan import (
+            compile_flag,
+            reset_compile_flag,
+        )
+
+        reset_compile_flag()
         t0 = time.monotonic()  # plan-monitor total_s (step-proof delta)
         self._last_px = False  # did the last query run through PX?
         self._last_dtl = False  # did it push down over the DTL exchange?
@@ -1051,7 +1108,8 @@ class Session:
                     rel = None
                     if dtl is not None:
                         try:
-                            rel = dtl.try_execute(p, monitor=monitor)
+                            rel = dtl.try_execute(p, monitor=monitor,
+                                                  collect=mon_collect)
                         except CapacityOverflow:
                             raise  # remote overflow: re-plan with 4x
                         except Exception:
@@ -1060,13 +1118,15 @@ class Session:
                     if rel is None and dop > 1:
                         rel = self._try_px(p, local_tables(), dop,
                                            factor=factor,
-                                           monitor=monitor)
+                                           monitor=monitor
+                                           if mon_collect else None)
                         self._last_px = rel is not None
                     if rel is None:
                         rel = execute_plan(p, local_tables(),
-                                           monitor_out=monitor)
+                                           monitor_out=monitor,
+                                           monitor_collect=mon_collect)
                     break
-                except CapacityOverflow:
+                except CapacityOverflow as ovf:
                     if attempt >= \
                             int(self.variables["max_capacity_retry"]):
                         # backstop: re-plan retries exhausted -> disk
@@ -1078,7 +1138,19 @@ class Session:
                         if res is not None:
                             return res
                         raise
-                    factor *= 4
+                    qmetrics.inc("plan.capacity_retries")
+                    if feedback_on:
+                        # the overflow report carries (lane, static cap,
+                        # rows dropped): jump straight to a clearing
+                        # budget instead of riding the blind 4x ladder
+                        from oceanbase_tpu.sql.optimizer import (
+                            overflow_jump_factor,
+                        )
+
+                        factor *= overflow_jump_factor(
+                            getattr(ovf, "drops", None))
+                    else:
+                        factor *= 4
                     if monitor is not None:
                         monitor.clear()
             xsp.tags.update(attempts=attempt + 1, factor=factor,
@@ -1094,10 +1166,34 @@ class Session:
                    self.catalog.schema_version)
             if key in self.plan_cache:
                 self._plan_cache_put(key, (p, outputs, _est))
-        if monitor is not None:
+        exec_elapsed = time.monotonic() - t0
+        path = ("dtl" if self._last_dtl
+                else "px" if self._last_px else "serial")
+        if monitor is not None and mon_collect:
             self.db.plan_monitor.record(
                 plan.fingerprint()[:64] if hasattr(plan, "fingerprint")
-                else "", monitor, time.monotonic() - t0)
+                else "", monitor, exec_elapsed,
+                logical_hash=lhash, retries=attempt, path=path)
+            if feedback_on and monitor and path == "serial":
+                # teach the feedback store from the serial ledger only:
+                # PX/DTL rows are positioned against rewritten plans, so
+                # their postorder would not line up with future binds
+                self.db.plan_feedback.observe(lhash, monitor)
+        if self.db is not None and \
+                getattr(self.db, "plan_history", None) is not None and \
+                attempt == 0 and not compile_flag():
+            # plan-regression watchdog: latency baselines per logical
+            # hash, independent of the plan-monitor knob (a regression
+            # must be visible even when per-op collection is off).
+            # Samples that paid an XLA compile or a CapacityOverflow
+            # retry replay are excluded — they measure one-time plan
+            # work, not the plan's steady-state latency, and would
+            # inflate the frozen baseline (blinding the watchdog) or
+            # spike the EWMA into a false regressed flag
+            if self.db.plan_history.record(
+                    lhash, exec_elapsed,
+                    float(self.db.config["plan_regress_threshold"])):
+                qmetrics.inc("plan.regressions")
         return self._materialize(rel, outputs)
 
     # -- ANN top-k access path (vector index) ---------------------------
@@ -1336,7 +1432,14 @@ class Session:
             if self.tenant is not None:
                 self.tenant.px_admission.release()
         if monitor is not None:
-            monitor.append((f"PxExecute(dop={dop})", int(rel.count())))
+            from oceanbase_tpu.exec.plan import q_error as _qe
+
+            est = getattr(plan, "est_rows", None)
+            act = int(rel.count())
+            monitor.append({"op": f"PxExecute(dop={dop})",
+                            "pos": len(monitor), "est": est,
+                            "rows": act, "q_error": _qe(est, act),
+                            "elapsed_s": 0.0})
         return rel
 
     def _materialize(self, rel: Relation, outputs) -> Result:
@@ -1485,6 +1588,34 @@ class Session:
             "batches": stats.batches, "elapsed_s": elapsed})
         if getattr(self.db, "wait_events", None) is not None:
             self.db.wait_events.add("spill io", elapsed)
+        if getattr(self.db, "plan_monitor", None) is not None and \
+                self.db.config["enable_sql_plan_monitor"]:
+            # the spill tier streams batches, so only the ROOT operator's
+            # output cardinality is observable whole — still enough for
+            # a q-error ledger row (plus the spill cost) on this path
+            from oceanbase_tpu.exec.plan import logical_hash as _lh
+            from oceanbase_tpu.exec.plan import monitored_postorder
+            from oceanbase_tpu.exec.plan import q_error as _qe
+
+            n_out = (len(next(iter(arrays.values())))
+                     if arrays else 0)
+            # the row must describe the operator that OWNS its postorder
+            # position: a pass-through root (Sort/Project) emits no
+            # monitor lane, so name/est come from the last MONITORED
+            # node — keeping (logical_hash, op_pos) joins consistent
+            # with the serial path's ledger rows
+            mon_nodes = monitored_postorder(plan)
+            row_node = mon_nodes[-1] if mon_nodes else plan
+            root_est = getattr(row_node, "est_rows", None)
+            op_rows = [{"op": type(row_node).__name__,
+                        "pos": max(len(mon_nodes) - 1, 0),
+                        "est": root_est, "rows": n_out,
+                        "q_error": _qe(root_est, n_out),
+                        "elapsed_s": elapsed,
+                        "spill_bytes": stats.bytes}]
+            self.db.plan_monitor.record(
+                plan_hash, op_rows, elapsed, logical_hash=_lh(plan),
+                spill_bytes=stats.bytes, path="spill")
         return self._materialize_host(arrays, valids, dtypes, outputs)
 
     def _catalog_provider(self, name: str):
@@ -1586,13 +1717,59 @@ class Session:
                 tables = {t: self._table_snapshot(t)
                           for t in referenced_tables(plan)
                           if self.catalog.has_table(t)}
+                # ANALYZE always collects per-operator rows: the user
+                # asked for actuals, so the enable_sql_plan_monitor knob
+                # does not gate this statement's own collection
                 monitor: list = []
-                execute_plan(plan, tables, monitor_out=monitor)
-                # monitor entries arrive in the executor's postorder; map
-                # them back to nodes for annotation
-                row_counts = dict(zip(_postorder_ids(plan),
-                                      (cnt for _n, cnt in monitor)))
+                factor = 1
+                an0 = time.monotonic()  # ledger total_s (step-proof)
+                for attempt in range(
+                        int(self.variables["max_capacity_retry"]) + 1):
+                    # the same retry ladder as execution: EXPLAIN
+                    # ANALYZE must survive the misestimates it exists
+                    # to expose (a CapacityOverflow IS the finding)
+                    try:
+                        p = plan if factor == 1 \
+                            else scale_capacities(plan, factor)
+                        execute_plan(p, tables, monitor_out=monitor)
+                        break
+                    except CapacityOverflow as ovf:
+                        if attempt >= int(
+                                self.variables["max_capacity_retry"]):
+                            raise
+                        from oceanbase_tpu.sql.optimizer import (
+                            overflow_jump_factor,
+                        )
+
+                        factor *= overflow_jump_factor(
+                            getattr(ovf, "drops", None))
+                        monitor.clear()
+                # monitor entries arrive in the executor's postorder
+                # (pass-through ops emit no lane); map them back to
+                # their nodes for annotation
+                from oceanbase_tpu.exec.plan import monitored_postorder
+
+                row_counts = dict(zip(
+                    (id(n) for n in monitored_postorder(plan)), monitor))
+                if self.db is not None and \
+                        getattr(self.db, "plan_monitor", None) is not None:
+                    from oceanbase_tpu.exec.plan import (
+                        logical_hash as _lh,
+                    )
+
+                    self.db.plan_monitor.record(
+                        plan.fingerprint()[:64], monitor,
+                        time.monotonic() - an0,
+                        logical_hash=_lh(plan), retries=attempt,
+                        path="serial")
         text = format_plan(plan, row_counts=row_counts) + spill_line
+        if row_counts:
+            worst = max(row_counts.values(),
+                        key=lambda r: r.get("q_error", 0.0))
+            if worst.get("q_error", 0.0) > 0.0:
+                text += (f"\nworst misestimate: {worst['op']} "
+                         f"est={worst['est']} act={worst['rows']} "
+                         f"q={worst['q_error']:.2f}")
         # access-path annotations (≙ the 'Outputs & filters ... access'
         # section of the reference's EXPLAIN)
         if self.db is not None:
@@ -2696,23 +2873,18 @@ def _ok(rowcount: int = 0) -> Result:
     return Result([], {}, {}, {}, rowcount=rowcount)
 
 
-def _postorder_ids(node) -> list:
-    out = []
-    for c in node.children():
-        out.extend(_postorder_ids(c))
-    out.append(id(node))
-    return out
-
-
 def format_plan(node, indent: int = 0, row_counts: dict | None = None) -> str:
     """EXPLAIN [ANALYZE] output (≙ src/sql/printer plan text; ANALYZE adds
-    actual output rows per operator from the plan-monitor lanes)."""
+    the estimate-vs-actual ledger per operator — ``[est=… act=… q=…]``
+    from the plan-monitor lanes, the worst misestimate flagged)."""
     from oceanbase_tpu.exec import plan as pp
 
     pad = "  " * indent
     name = type(node).__name__
     attrs = []
     for k, v in vars(node).items():
+        if k == "est_rows" or k.startswith("_"):
+            continue  # ledger annotation / memoized metadata
         if isinstance(v, pp.PlanNode) or k in ("child", "left", "right",
                                                "inputs"):
             continue
@@ -2722,7 +2894,10 @@ def format_plan(node, indent: int = 0, row_counts: dict | None = None) -> str:
         attrs.append(f"{k}={s}")
     line = f"{pad}{name}({', '.join(attrs)})"
     if row_counts is not None and id(node) in row_counts:
-        line += f"  [rows={row_counts[id(node)]}]"
+        r = row_counts[id(node)]
+        est = r["est"] if r.get("est") is not None else "?"
+        line += (f"  [est={est} act={r['rows']} "
+                 f"q={r.get('q_error', 0.0):.2f}]")
     kids = list(node.children())
     return "\n".join([line] + [format_plan(c, indent + 1, row_counts)
                                for c in kids])

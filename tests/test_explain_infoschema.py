@@ -12,12 +12,15 @@ def test_explain_analyze_row_counts(tmp_path):
     s.execute("insert into t values (1, 1), (2, 2), (3, 3), (4, 4)")
     r = s.execute("explain analyze select sum(v) from t where k >= 3")
     text = r.plan_text
-    assert "TableScan" in text and "[rows=4]" in text
-    assert "Filter" in text and "[rows=2]" in text
-    assert "ScalarAgg" in text and "[rows=1]" in text
-    # plain EXPLAIN has no row annotations and does not execute
+    assert "TableScan" in text and "act=4" in text
+    assert "Filter" in text and "act=2" in text
+    assert "ScalarAgg" in text and "act=1" in text
+    # the estimate-vs-actual ledger rides every annotation
+    assert "[est=" in text and "q=" in text
+    assert "worst misestimate:" in text
+    # plain EXPLAIN has no ledger annotations and does not execute
     r = s.execute("explain select sum(v) from t")
-    assert "[rows=" not in r.plan_text
+    assert "[est=" not in r.plan_text and "act=" not in r.plan_text
     db.close()
 
 

@@ -11,11 +11,14 @@ def test_plan_monitor_rows(tmp_path):
     s.execute("select sum(v) from t where k >= 2")
     recent = db.plan_monitor.recent(5)
     assert recent, "plan monitor should have entries"
-    _, _, op_stats, total_s = recent[-1]
-    ops = dict(op_stats)
+    rec = recent[-1]
+    ops = {r["op"]: r["rows"] for r in rec.op_stats}
     assert ops.get("TableScan") == 3
     assert ops.get("Filter") == 2
     assert ops.get("ScalarAgg") == 1
+    # the estimate-vs-actual ledger rides every row
+    assert all("est" in r and "q_error" in r for r in rec.op_stats)
+    assert rec.logical_hash and rec.path == "serial"
     # surfaced through SQL too
     r = s.execute("select operator, output_rows from gv$plan_monitor "
                   "where operator = 'Filter'")
