@@ -28,6 +28,12 @@ Rule vocabulary (the actions the consult sites understand):
     garble  flip bits in the frame payload (codec-level corruption; the
             receiver must close the desynchronized connection).
     crash   os._exit(137) — a process failure mid-protocol.
+    bitflip/truncate (where="disk" only): corrupt a just-persisted file
+            in place — ``verb`` names the artifact kind (segment,
+            manifest, slog, wal).  The persistence boundaries
+            (StorageEngine / PalfReplica) consult ``act_disk`` after
+            every durable write, so seeded disk-rot schedules replay
+            deterministically against the checksum + scrub plane.
 
 Matching: verb (None = any), peer node id (None = any; on the client
 side the destination, on the server side the sender's ``src`` field),
@@ -45,8 +51,13 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-WHERES = ("send", "recv", "reply")
-ACTIONS = ("drop", "reset", "delay", "garble", "crash")
+WHERES = ("send", "recv", "reply", "disk")
+ACTIONS = ("drop", "reset", "delay", "garble", "crash",
+           "bitflip", "truncate")
+
+#: artifact kinds the persistence boundaries report to ``act_disk``
+#: (rule.verb matches against these; None = any artifact)
+DISK_KINDS = ("segment", "manifest", "slog", "wal")
 
 
 class FaultDrop(ConnectionError):
@@ -110,6 +121,14 @@ class FaultPlane:
             raise ValueError(
                 "garble is not applicable to where='recv'; use "
                 "where='send' to corrupt requests")
+        if (action in ("bitflip", "truncate")) != (where == "disk"):
+            raise ValueError(
+                "bitflip/truncate pair only with where='disk' "
+                "(persisted-file faults; verb names the artifact kind)")
+        if where == "disk" and verb is not None and \
+                verb not in DISK_KINDS:
+            raise ValueError(
+                f"disk fault kind must be one of {DISK_KINDS}: {verb!r}")
         with self._lock:
             rid = next(self._ids)
             rule = FaultRule(
@@ -153,6 +172,15 @@ class FaultPlane:
                      where: str = "reply", nth: int | None = None) -> int:
         return self.inject(where, "garble", verb=verb, prob=prob,
                            nth=nth)
+
+    def disk(self, action: str, kind: str | None = None,
+             nth: int | None = None, count: int = 1,
+             prob: float = 1.0, seed: int | None = None) -> int:
+        """Arm one persisted-file fault: ``action`` in
+        {bitflip, truncate}, ``kind`` in DISK_KINDS (None = any).
+        Defaults to a one-shot (count=1) — media rot, not a firehose."""
+        return self.inject("disk", action, verb=kind, nth=nth,
+                           count=count, prob=prob, seed=seed)
 
     def clear(self, rule_id: int | None = None) -> int:
         """Remove one rule (or all when ``rule_id`` is None);
@@ -216,6 +244,77 @@ class FaultPlane:
         if verdict == "garble" and payload is not None:
             return _garble(payload)
         return payload
+
+
+    # ------------------------------------------------------------------
+    # the disk consult site (persistence boundaries: StorageEngine
+    # segment/slog/manifest writes, PalfReplica WAL appends)
+    # ------------------------------------------------------------------
+    def act_disk(self, kind: str, path: str):
+        """Consult the plane after ``path`` (an artifact of ``kind``)
+        was durably written.  Armed bitflip/truncate rules corrupt the
+        just-persisted bytes in place — the deterministic stand-in for
+        media rot that the checksum plane must catch on the next read.
+        The no-rules fast path is one attribute read."""
+        if not self._rules:
+            return
+        actions: list[tuple[str, random.Random]] = []
+        with self._lock:
+            for r in self._rules:
+                if r.where != "disk":
+                    continue
+                if r.verb is not None and r.verb != kind:
+                    continue
+                r.matched += 1
+                if r.nth is not None and r.matched != r.nth:
+                    continue
+                if r.count == 0:
+                    continue
+                if r.prob < 1.0 and r.rng.random() >= r.prob:
+                    continue
+                if r.count > 0:
+                    r.count -= 1
+                r.fired += 1
+                actions.append((r.action, r.rng))
+        for action, rng in actions:
+            if action == "bitflip":
+                bitflip_file(path, rng=rng)
+            elif action == "truncate":
+                truncate_file(path, rng=rng)
+
+
+def bitflip_file(path: str, rng: random.Random | None = None,
+                 seed: int = 0) -> int:
+    """Flip ONE seeded bit of ``path`` in place; -> the byte offset.
+    Offsets draw from the middle 80% of the file so the flip lands in
+    payload, not in the first magic bytes (whose corruption is a
+    different, already-covered failure mode)."""
+    rng = rng if rng is not None else random.Random(seed)
+    size = os.path.getsize(path)
+    if size == 0:
+        return -1
+    lo, hi = size // 10, max(size // 10 + 1, size - size // 10)
+    off = rng.randrange(lo, hi)
+    bit = 1 << rng.randrange(8)
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ bit]))
+    return off
+
+
+def truncate_file(path: str, rng: random.Random | None = None,
+                  seed: int = 0) -> int:
+    """Cut a seeded fraction (5–50%) off the file's tail; -> new size."""
+    rng = rng if rng is not None else random.Random(seed)
+    size = os.path.getsize(path)
+    if size == 0:
+        return 0
+    keep = max(1, size - max(1, int(size * rng.uniform(0.05, 0.5))))
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+    return keep
 
 
 def _garble(payload: bytes) -> bytes:

@@ -87,6 +87,7 @@ class NodeDatabase:
         self.dtl_metrics = DtlMetrics()
         self.dtl = None  # DtlExchange, installed by NodeServer
         self.health = None  # HealthMonitor, installed by NodeServer
+        self.scrub = None  # ScrubState, installed by NodeServer
         self.virtual_tables = VirtualTables(self)
         self._session_ids = itertools.count(1)
 
@@ -159,6 +160,13 @@ class NodeServer:
         boot_trace = qtrace.TraceCtx(
             f"boot-{node_id}-{uuid.uuid4().hex[:8]}", node=node_id)
         with qtrace.activate(boot_trace):
+            if root:
+                # baseline integrity is NOT gated by the rebuild knob:
+                # a digest-failing manifest/slog pair quarantines here
+                # regardless, so boot falls back to WAL replay instead
+                # of trusting (or crashing on) rot
+                _rebuild.quarantine_corrupt_baseline(
+                    root, recovery=self.recovery)
             if root and bool(self.config["enable_auto_rebuild"]):
                 _rebuild.maybe_rebuild(
                     root, node_id, self.peers, recovery=self.recovery,
@@ -167,10 +175,20 @@ class NodeServer:
             wal_dir = os.path.join(root, "wal") if root else None
             self.palf = NetPalf(node_id, self.peers, log_dir=wal_dir,
                                 apply_cb=self._apply_entry,
-                                lease_ms=lease_ms)
+                                lease_ms=lease_ms,
+                                recovery=self.recovery)
+            # quarantine policy: a cluster node has peers to refetch a
+            # checksum-failing segment from, so boot quarantines and
+            # the scrub plane repairs instead of failing the boot
             self.tenant = Tenant("sys", root, self.config,
-                                 wal=self.palf, recovery=self.recovery)
+                                 wal=self.palf, recovery=self.recovery,
+                                 corrupt_policy="quarantine")
         self.engine = self.tenant.engine
+        # persistence boundaries consult the disk-fault plane (seeded
+        # bitflip/truncate of just-written files; gated at arm time by
+        # enable_disk_faults in _h_fault_inject)
+        self.engine.faults = self.faults
+        self.palf.replica.faults = self.faults
         self.tx = self.tenant.tx
         self.catalog = self.tenant.catalog
         # replicate logical DDL through the log stream (followers apply
@@ -197,6 +215,10 @@ class NodeServer:
         self.db.health = self.health
 
         self.rebuild = _rebuild.RebuildServer(self)
+        from oceanbase_tpu.storage.scrub import Scrubber
+
+        self.scrubber = Scrubber(self)
+        self.db.scrub = self.scrubber.state
         handlers = {
             "ping": lambda: "pong",
             "das.scan": self._h_scan,
@@ -209,6 +231,8 @@ class NodeServer:
             "metrics.scrape": self._h_metrics,
             "fault.inject": self._h_fault_inject,
             "fault.clear": self._h_fault_clear,
+            "scrub.checksum": self.scrubber.checksum_handler,
+            "scrub.run": self._h_scrub_run,
             **self.rebuild.handlers(),
             **self.palf.handlers(),
         }
@@ -305,6 +329,10 @@ class NodeServer:
             raise PermissionError(
                 "fault injection disabled: alter system set "
                 "enable_fault_injection = true first")
+        if where == "disk" and not bool(self.config["enable_disk_faults"]):
+            raise PermissionError(
+                "disk faults disabled: alter system set "
+                "enable_disk_faults = true first")
         rid = self.faults.inject(where, action, verb=verb, peer=peer,
                                  prob=prob, nth=nth, count=count,
                                  delay_ms=delay_ms, seed=seed)
@@ -313,6 +341,12 @@ class NodeServer:
     def _h_fault_clear(self, rule_id=None):
         return {"removed": self.faults.clear(rule_id),
                 "node_id": self.node_id}
+
+    def _h_scrub_run(self):
+        """Admin verb: run one scrub round NOW (detect → quarantine →
+        repair → parity) and return its summary — the periodic loop's
+        cadence is for production, benches/tests want determinism."""
+        return self.scrubber.run_once()
 
     def _on_peer_down(self, pid: int):
         """Failure-detector down transition: stop routing at the dead
@@ -562,6 +596,9 @@ class NodeServer:
         self._ckpt = threading.Thread(target=self._checkpoint_loop,
                                       daemon=True)
         self._ckpt.start()
+        self._scrub = threading.Thread(target=self._scrub_loop,
+                                       daemon=True)
+        self._scrub.start()
         self.health.start()
         if bool(self.config["enable_ash"]):
             self.db.ash.start()
@@ -605,6 +642,25 @@ class NodeServer:
                     self.tenant.checkpoint()
             except Exception:
                 pass  # transient flush failure: retry next interval
+
+    def _scrub_loop(self):
+        """Periodic scrub rounds (storage/scrub.py): local re-verify,
+        cross-replica digest vote, auto-repair.  The knob pair is read
+        live — the wait ticks at most 1 s at a time so ALTER SYSTEM SET
+        scrub_interval_s retunes the cadence without riding out a long
+        in-flight sleep."""
+        last = time.monotonic()
+        while not self._stop.wait(
+                min(float(self.config["scrub_interval_s"]), 1.0)):
+            try:
+                if time.monotonic() - last < \
+                        float(self.config["scrub_interval_s"]):
+                    continue
+                last = time.monotonic()
+                if bool(self.config["enable_scrub"]):
+                    self.scrubber.run_once()
+            except Exception:
+                pass  # transient (peer churn mid-round): next round
 
     def stop(self):
         self._stop.set()

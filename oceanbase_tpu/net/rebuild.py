@@ -23,9 +23,13 @@ Protocol (server side registered on every NodeServer):
         catch-up re-ships.
 
     rebuild.fetch_segments(name, offset, limit)
-        -> {"data": bytes, "eof": bool, "size": int}
+        -> {"data": bytes, "eof": bool, "size": int, "crc": int}
         One chunk of one baseline file (byte-accounted, idempotent —
-        the retry budget in net/rpc.py::POLICIES applies).
+        the retry budget in net/rpc.py::POLICIES applies).  Every chunk
+        carries a crc64 the client verifies BEFORE writing (a corrupt
+        chunk re-fetches, bounded); listed data files additionally carry
+        a whole-file crc in fetch_meta, re-verified after assembly —
+        corrupt bytes are never installed.
 
 Client side (``maybe_rebuild``) runs BEFORE the tenant boots: files
 download into ``<root>/.rebuild_tmp`` and install in crash-safe order
@@ -41,9 +45,15 @@ import os
 import shutil
 import time
 
+from oceanbase_tpu.native import crc64
 from oceanbase_tpu.server import trace as qtrace
+from oceanbase_tpu.storage.integrity import CorruptionError
 
 log = logging.getLogger(__name__)
+
+#: per-chunk crc-mismatch refetch budget (on top of the rpc-level retry
+#: policy — that one covers LOST frames, this one corrupted payloads)
+CHUNK_CRC_RETRIES = 3
 
 #: default chunk budget per rebuild.fetch_segments call (overridable via
 #: the rebuild_chunk_bytes knob); well under the 1 GiB frame cap
@@ -64,6 +74,12 @@ class RebuildServer:
 
     def __init__(self, node):
         self.node = node
+        # whole-file digest cache for fetch_meta's listing: baseline
+        # data files are write-once under a given name, so (size,
+        # mtime_ns) identity makes re-reading the whole dataset per
+        # fetch_meta call unnecessary — repairs call fetch_meta per
+        # table/attempt and must not pay O(dataset) each time
+        self._crc_cache: dict[str, tuple[int, int, int]] = {}
 
     def handlers(self) -> dict:
         return {"rebuild.fetch_meta": self.fetch_meta,
@@ -98,13 +114,17 @@ class RebuildServer:
         files = []
         for base, _dirs, names in os.walk(ddir):
             for n in sorted(names):
-                if n.endswith(".tmp") or \
+                if n.endswith(".tmp") or ".corrupt" in n or \
                         n in ("manifest.json", "slog.jsonl"):
                     continue
                 p = os.path.join(base, n)
                 rel = os.path.join("data", os.path.relpath(p, ddir))
+                # immutable data files carry a whole-file digest the
+                # client re-verifies after chunked assembly (the WAL is
+                # append-only — its digest would race appends; its
+                # entry-level crc64s cover it at boot instead)
                 files.append({"name": rel, "size": os.path.getsize(p),
-                              "kind": "data"})
+                              "kind": "data", "crc": self._file_crc(p)})
         wal = self._wal_path()
         if os.path.exists(wal):
             files.append({"name": WAL_NAME,
@@ -113,7 +133,22 @@ class RebuildServer:
                 "wal_lsn": self.node.engine.meta.get("wal_lsn", 0),
                 "role": self.node.palf.replica.role,
                 "manifest": manifest, "slog": slog,
+                "manifest_crc": crc64(manifest), "slog_crc": crc64(slog),
                 "files": files}
+
+    def _file_crc(self, path: str) -> int:
+        """crc64 of one baseline file, cached by (size, mtime_ns)
+        identity — sound because data files are write-once under a
+        given name (compaction/repair mint fresh ids)."""
+        st = os.stat(path)
+        hit = self._crc_cache.get(path)
+        if hit is not None and hit[0] == st.st_size \
+                and hit[1] == st.st_mtime_ns:
+            return hit[2]
+        with open(path, "rb") as f:
+            crc = crc64(f.read())
+        self._crc_cache[path] = (st.st_size, st.st_mtime_ns, crc)
+        return crc
 
     def _resolve(self, name: str) -> str:
         """Map a wire file name to a real path, refusing traversal.
@@ -141,7 +176,7 @@ class RebuildServer:
         with open(p, "rb") as f:
             f.seek(int(offset))
             data = f.read(limit)
-        return {"data": data, "size": size,
+        return {"data": data, "size": size, "crc": crc64(data),
                 "eof": int(offset) + len(data) >= size}
 
 
@@ -186,6 +221,47 @@ def _pick_source(peers: dict) -> tuple[int, object, dict] | None:
     return None if best is None else best[1:]
 
 
+def fetch_file(cli, name: str, dst: str,
+               chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+               expect_crc: int | None = None) -> int:
+    """Stream one baseline file over chunked ``rebuild.fetch_segments``
+    with every chunk crc-verified before it is written (a corrupt chunk
+    re-fetches, bounded by CHUNK_CRC_RETRIES) and an optional whole-file
+    digest check after assembly.  -> bytes downloaded.  Shared by the
+    wiped-node rebuild AND the scrub plane's segment repair."""
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    nbytes = 0
+    with open(dst, "wb") as out:
+        off = 0
+        while True:
+            r = None
+            for attempt in range(CHUNK_CRC_RETRIES):
+                r = cli.call("rebuild.fetch_segments", name=name,
+                             offset=off, limit=int(chunk_bytes))
+                if "crc" not in r or crc64(r["data"]) == r["crc"]:
+                    break
+                log.warning("rebuild: chunk crc mismatch %s@%d "
+                            "(attempt %d)", name, off, attempt + 1)
+            else:
+                raise CorruptionError(
+                    f"rebuild chunk crc mismatch after "
+                    f"{CHUNK_CRC_RETRIES} attempts: {name}@{off}",
+                    kind="rebuild", path=name)
+            out.write(r["data"])
+            off += len(r["data"])
+            nbytes += len(r["data"])
+            if r["eof"] or not r["data"]:
+                break
+    if expect_crc is not None:
+        with open(dst, "rb") as f:
+            got = crc64(f.read())
+        if got != expect_crc:
+            raise CorruptionError(
+                f"rebuild file digest mismatch: {name}",
+                kind="rebuild", path=name)
+    return nbytes
+
+
 def rebuild_from_peer(root: str, node_id: int, peers: dict,
                       recovery=None,
                       chunk_bytes: int = DEFAULT_CHUNK_BYTES):
@@ -205,24 +281,23 @@ def rebuild_from_peer(root: str, node_id: int, peers: dict,
         nbytes = 0
         for f in meta["files"]:
             dst = os.path.join(tmp, f["name"])
-            os.makedirs(os.path.dirname(dst), exist_ok=True)
-            with open(dst, "wb") as out:
-                off = 0
-                while True:
-                    r = cli.call("rebuild.fetch_segments",
-                                 name=f["name"], offset=off,
-                                 limit=int(chunk_bytes))
-                    out.write(r["data"])
-                    off += len(r["data"])
-                    nbytes += len(r["data"])
-                    if r["eof"] or not r["data"]:
-                        break
+            nbytes += fetch_file(cli, f["name"], dst,
+                                 chunk_bytes=int(chunk_bytes),
+                                 expect_crc=f.get("crc"))
         # manifest + slog came inline with fetch_meta: the point-in-time
-        # pair that matches the segment list we just streamed
+        # pair that matches the segment list we just streamed — each
+        # verified against its fetch_meta digest before install
         os.makedirs(os.path.join(tmp, "data"), exist_ok=True)
-        for rel, blob in (("slog.jsonl", meta.get("slog", b"")),
-                          ("manifest.json", meta.get("manifest", b""))):
+        for rel, blob, crc in (
+                ("slog.jsonl", meta.get("slog", b""),
+                 meta.get("slog_crc")),
+                ("manifest.json", meta.get("manifest", b""),
+                 meta.get("manifest_crc"))):
             if blob:
+                if crc is not None and crc64(blob) != crc:
+                    raise CorruptionError(
+                        f"rebuild {rel} digest mismatch",
+                        kind="rebuild", path=rel)
                 with open(os.path.join(tmp, "data", rel), "wb") as out:
                     out.write(blob)
                 nbytes += len(blob)
@@ -267,22 +342,68 @@ def _install(root: str, node_id: int, tmp: str, files: list[dict]):
     move(manifest, manifest)
 
 
+def quarantine_corrupt_baseline(root: str, recovery=None):
+    """Pre-boot integrity check of the local checkpoint baseline: a
+    manifest or slog that fails its digest is quarantined (BOTH move
+    aside — they are one point-in-time pair) so boot never trusts a
+    rotten table/segment list.  The WAL stays: its entry-level crc64s
+    self-verify at open, and full replay + leader catch-up reconstruct
+    the state the quarantined checkpoint described."""
+    from oceanbase_tpu.storage.engine import (
+        load_manifest,
+        quarantine_file,
+        read_slog,
+    )
+
+    data = os.path.join(root, "data")
+    manifest = os.path.join(data, "manifest.json")
+    slog = os.path.join(data, "slog.jsonl")
+    bad = None
+    try:
+        if os.path.exists(manifest):
+            load_manifest(manifest)
+        if os.path.exists(slog) and os.path.getsize(slog):
+            for _op in read_slog(slog):
+                pass
+    except CorruptionError as e:
+        bad = e
+    if bad is None:
+        return False
+    quarantined = []
+    for p in (manifest, slog):
+        if os.path.exists(p):
+            quarantined.append(os.path.basename(quarantine_file(p)))
+    log.warning("node baseline corrupt (%s): quarantined %s; booting "
+                "by WAL replay / rebuild", bad, quarantined)
+    if recovery is not None:
+        recovery.record("quarantine", note=f"{bad.kind or 'baseline'} "
+                        f"digest mismatch -> {','.join(quarantined)}")
+    return True
+
+
 def maybe_rebuild(root: str, node_id: int, peers: dict, recovery=None,
                   chunk_bytes: int = DEFAULT_CHUNK_BYTES):
     """The boot hook: rebuild iff this node is wiped AND a peer has
     data.  Runs BEFORE the engine/WAL open, so a rebuilt node boots
-    through the ordinary restart path (checkpoint + WAL tail replay)."""
+    through the ordinary restart path (checkpoint + WAL tail replay).
+    A baseline failing its digests counts as wiped-of-baseline: the
+    corrupt manifest/slog quarantine first, then either the rebuild
+    path (no WAL) or full WAL replay reconstructs state."""
     from oceanbase_tpu.net.rpc import RpcError
 
-    if not root or not needs_rebuild(root, node_id):
+    if not root:
+        return None
+    quarantine_corrupt_baseline(root, recovery=recovery)
+    if not needs_rebuild(root, node_id):
         return None
     try:
         return rebuild_from_peer(root, node_id, peers,
                                  recovery=recovery,
                                  chunk_bytes=chunk_bytes)
-    except (OSError, RpcError) as e:
-        # a source dying mid-rebuild leaves only .rebuild_tmp behind:
-        # boot continues empty and ordinary catch-up replays the log
+    except (OSError, RpcError, CorruptionError) as e:
+        # a source dying mid-rebuild (or shipping bytes that fail their
+        # digests past the retry budget) leaves only .rebuild_tmp
+        # behind: boot continues empty and catch-up replays the log
         log.warning("node %d: rebuild aborted (%s); booting empty",
                     node_id, e)
         shutil.rmtree(os.path.join(root, ".rebuild_tmp"),

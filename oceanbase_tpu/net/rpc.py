@@ -139,6 +139,12 @@ POLICIES: dict[str, VerbPolicy] = {
     # metrics.scrape is a pure read of monotonic counters — re-asking
     # returns a superset-or-equal snapshot, trivially idempotent
     "metrics.scrape": VerbPolicy(5.0, True, 2, 0.02, 0.20),
+    # scrub plane (storage/scrub.py): checksum is a pure snapshot read;
+    # run triggers a verify/repair round that CONVERGES — re-running
+    # after a lost reply re-verifies already-repaired state, a no-op —
+    # so both carry bounded retry budgets
+    "scrub.checksum": VerbPolicy(60.0, True, 2, 0.05, 0.50),
+    "scrub.run":      VerbPolicy(300.0, True, 1, 0.10, 1.00),
     "sql.execute":  VerbPolicy(600.0, False),
 }
 

@@ -44,6 +44,41 @@ qmetrics.declare("palf.entries_applied", "counter",
 _HDR = struct.Struct("<QQIQ")  # term, lsn(index), payload_len, crc64
 _MAGIC = b"OBTPULG1"  # file magic + format version (bump on layout change)
 
+# quarantine retention (shared with the data-dir boundary):
+# storage/integrity.py owns the pruner, re-exported here for callers
+from oceanbase_tpu.storage.integrity import (  # noqa: E402
+    QUARANTINE_KEEP,
+    QUARANTINE_MAX_AGE_S,
+    prune_quarantine,
+)
+
+
+def scan_wal(buf: bytes) -> tuple[list[LogEntry], int, int]:
+    """Shared WAL tail scan over a log file body (after the magic):
+    -> (entries, valid_off, crc_failed_lsn).  ``valid_off`` is the end
+    of the last fully-validated entry; ``crc_failed_lsn`` is non-zero
+    when the scan stopped at a COMPLETE entry failing its crc64 (rot)
+    rather than an incomplete torn append.  Every consumer of the
+    on-disk entry format goes through here — recovery, backup
+    verification, PITR — so a layout bump changes one scanner."""
+    entries: list[LogEntry] = []
+    off = len(_MAGIC)
+    valid_off = off
+    crc_failed_lsn = 0
+    while off + _HDR.size <= len(buf):
+        term, lsn, plen, crc = _HDR.unpack_from(buf, off)
+        off += _HDR.size
+        if off + plen > len(buf):
+            break  # torn tail write: discard (≙ log tail scan)
+        payload = buf[off:off + plen]
+        if crc64(struct.pack("<QQ", term, lsn) + payload) != crc:
+            crc_failed_lsn = lsn
+            break
+        entries.append(LogEntry(term, lsn, payload))
+        off += plen
+        valid_off = off
+    return entries, valid_off, crc_failed_lsn
+
 
 @dataclass
 class LogEntry:
@@ -64,10 +99,15 @@ class PalfReplica:
     """One replica of one log stream (host state machine + disk log)."""
 
     def __init__(self, replica_id: int, log_dir: str | None = None,
-                 apply_cb: Optional[Callable] = None):
+                 apply_cb: Optional[Callable] = None, recovery=None):
         self.replica_id = replica_id
         self.log_dir = log_dir
         self.apply_cb = apply_cb
+        # recovery-event sink (storage/recovery.py RecoveryState or
+        # None): quarantined/truncated WAL bytes surface in gv$recovery
+        self.recovery = recovery
+        # disk-fault plane hook (net/faults.py), armed by NodeServer
+        self.faults = None
         self.entries: list[LogEntry] = []   # 0-based list, lsn = idx+1
         self.committed_lsn = 0
         self.applied_lsn = 0
@@ -107,6 +147,8 @@ class PalfReplica:
         os.fsync(self._log_f.fileno())
         qmetrics.inc("palf.fsyncs")
         qmetrics.observe("palf.fsync_s", time.perf_counter() - t0)
+        if self.faults is not None:
+            self.faults.act_disk("wal", self._log_path())
 
     def _truncate_disk(self):
         """Rewrite the on-disk log after a suffix truncation."""
@@ -134,26 +176,27 @@ class PalfReplica:
             # unknown/older format: refuse to guess — quarantine the file
             # so a later append cannot land BEHIND unreadable bytes that
             # the next recovery would stop at (peer catch-up restores
-            # state; a format migration tool would go here)
+            # state; a format migration tool would go here).  Quarantine
+            # files get unique names, surface in gv$recovery
+            # (phase=quarantine) and are retention-capped by count/age —
+            # repeated corruption must never grow the dir unbounded or
+            # vanish without an operator-visible trace.
             if buf:
-                os.replace(path, path + ".corrupt")
+                qpath = f"{path}.corrupt.{time.time_ns():x}"
+                os.replace(path, qpath)
                 log.warning("palf replica %d: quarantined %d unreadable "
                             "log bytes to %s", self.replica_id, len(buf),
-                            path + ".corrupt")
+                            qpath)
+                if self.recovery is not None:
+                    self.recovery.record(
+                        "quarantine", nbytes=len(buf),
+                        note=f"wal bad magic -> {os.path.basename(qpath)}")
+                prune_quarantine(self.log_dir)
             return
-        off = len(_MAGIC)
-        valid_off = off  # end of the last fully-validated entry
-        while off + _HDR.size <= len(buf):
-            term, lsn, plen, crc = _HDR.unpack_from(buf, off)
-            off += _HDR.size
-            if off + plen > len(buf):
-                break  # torn tail write: discard (≙ log tail scan)
-            payload = buf[off:off + plen]
-            if crc64(struct.pack("<QQ", term, lsn) + payload) != crc:
-                break  # corrupt tail: stop replay here (≙ checksum scan)
-            self.entries.append(LogEntry(term, lsn, payload))
-            off += plen
-            valid_off = off
+        # crc_failed_lsn != 0: the scan stopped at a COMPLETE entry
+        # failing its crc (rot — worth a gv$recovery quarantine row
+        # below), vs 0 for an ordinary torn append
+        self.entries, valid_off, crc_failed_lsn = scan_wal(buf)
         if valid_off < len(buf):
             # torn/corrupt tail bytes follow the last valid entry.  They
             # MUST be physically truncated before any append: _persist
@@ -168,6 +211,14 @@ class PalfReplica:
                 "palf replica %d: truncated %d torn/corrupt tail bytes "
                 "(log keeps %d entries)", self.replica_id,
                 len(buf) - valid_off, len(self.entries))
+            if crc_failed_lsn and self.recovery is not None:
+                # rot (vs an ordinary crash's torn append, which is
+                # expected and stays a log line): surface it
+                self.recovery.record(
+                    "quarantine", nbytes=len(buf) - valid_off,
+                    wal_start_lsn=crc_failed_lsn,
+                    note=f"wal entry lsn={crc_failed_lsn} crc mismatch;"
+                         " tail truncated (catch-up re-ships)")
         if self.entries:
             self.current_term = self.entries[-1].term
 

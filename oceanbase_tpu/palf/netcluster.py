@@ -52,11 +52,12 @@ class NetPalf:
     def __init__(self, node_id: int, peers: dict[int, "RpcClient"],
                  log_dir: str | None = None,
                  apply_cb: Optional[Callable] = None,
-                 lease_ms: int = 2000):
+                 lease_ms: int = 2000, recovery=None):
         """peers: {node_id: RpcClient} for every OTHER node."""
         self.node_id = node_id
         self.peers = peers
-        self.replica = PalfReplica(node_id, log_dir, apply_cb=apply_cb)
+        self.replica = PalfReplica(node_id, log_dir, apply_cb=apply_cb,
+                                   recovery=recovery)
         self.acceptor = ElectionAcceptor(self.replica)
         self.proposer = ElectionProposer(self.replica, self._vote_rpc,
                                          lease_ms=lease_ms)

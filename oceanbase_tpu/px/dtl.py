@@ -66,6 +66,9 @@ qmetrics.declare("dtl.slice_skew", "histogram",
                  "max/mean output rows across one exchange's slices "
                  "(1.0 = perfectly balanced; partition skew the CBO "
                  "must price around)")
+qmetrics.declare("dtl.digest_mismatches", "counter",
+                 "exchange replies whose payload digest failed on the "
+                 "coordinator (slice re-ran locally — never merged)")
 
 #: name of the coordinator-side relation holding the merged exchange rows
 DTL_TABLE = "__dtl_recv__"
@@ -485,16 +488,40 @@ def execute_fragment(ts, plan_enc: dict, snapshot: int, part: int,
     r_valids = {k[len("__valid__"):]: v for k, v in raw.items()
                 if k.startswith("__valid__")}
     rows = len(next(iter(r_arrays.values()))) if r_arrays else 0
+    from oceanbase_tpu.storage.integrity import arrays_crc
+
     reply = {
         "arrays": r_arrays, "valids": r_valids,
         "types": {name: [c.dtype.kind.value, c.dtype.precision or 0,
                          c.dtype.scale or 0]
                   for name, c in out.columns.items()},
         "rows": rows, "scanned": scanned,
+        # end-to-end payload digest: the coordinator re-hashes the
+        # decoded reply before merging (verify_reply), so corruption
+        # anywhere between this result boundary and the merge — wire,
+        # codec, allocator — turns into a local re-run, never rows
+        "crc": arrays_crc(r_arrays, r_valids),
     }
     if with_ops:
         reply["ops"] = [int(r["rows"]) for r in mon]
     return reply
+
+
+def verify_reply(reply: dict, part: int, peer: int):
+    """Coordinator-side digest check of one exchange reply.  Raises
+    CorruptionError (triaged like a slice failure: the coordinator
+    re-runs the slice on its own replica)."""
+    from oceanbase_tpu.storage.integrity import CorruptionError, arrays_crc
+
+    crc = reply.get("crc")
+    if crc is None:
+        return  # pre-integrity peer build
+    got = arrays_crc(reply.get("arrays", {}), reply.get("valids", {}))
+    if got != crc:
+        qmetrics.inc("dtl.digest_mismatches")
+        raise CorruptionError(
+            f"dtl reply digest mismatch (part {part}, peer {peer})",
+            kind="dtl")
 
 
 def merge_fragments(parts: list[dict]) -> Relation:
@@ -725,6 +752,7 @@ class DtlExchange:
                                 part=i, nparts=nparts,
                                 applied_lsn=lsn, with_ops=want_ops,
                                 monitor_lanes=want_lanes)
+                            verify_reply(res, i, cli.peer_id)
                             results[i] = res
                             ship_bytes[i] = sent + recv
                         except Exception as e:  # noqa: BLE001 — triaged
@@ -759,11 +787,17 @@ class DtlExchange:
                     # static budgets overflowed remotely: surface it so
                     # the session re-plans (scaled caps re-serialize)
                     raise CapacityOverflow(str(err))
-                if not isinstance(err,
-                                  (RpcError, OSError, ConnectionError)):
+                from oceanbase_tpu.storage.integrity import (
+                    CorruptionError,
+                )
+
+                if not isinstance(err, (RpcError, OSError,
+                                        ConnectionError,
+                                        CorruptionError)):
                     raise err
-                # node down / lagging replica / schema not yet applied:
-                # run that slice on the local replica instead
+                # node down / lagging replica / schema not yet applied /
+                # reply failed its payload digest: run that slice on
+                # the local replica instead
                 with qtrace.span("dtl.slice", part=i, local=1,
                                  fallback=1):
                     s0 = time.monotonic()

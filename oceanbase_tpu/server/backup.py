@@ -25,8 +25,9 @@ from __future__ import annotations
 import json
 import os
 import shutil
-import struct
 import time
+
+from oceanbase_tpu.storage.integrity import CorruptionError
 
 MANIFEST = "BACKUP_MANIFEST.json"
 
@@ -40,6 +41,42 @@ def _walk(root: str) -> dict[str, int]:
     return out
 
 
+def verify_wal_file(path: str):
+    """Verify every entry crc64 of one replica WAL copy; raises
+    CorruptionError on the first mismatch.  A torn TAIL (header/payload
+    running past EOF) is a crash artifact the boot scan truncates, not
+    corruption — but a bad crc on complete bytes means the archive
+    would preserve rot forever, so the backup must fail loudly."""
+    from oceanbase_tpu.palf.log import _MAGIC, scan_wal
+
+    with open(path, "rb") as fh:
+        buf = fh.read()
+    if not buf.startswith(_MAGIC):
+        if buf:
+            raise CorruptionError(f"backup WAL bad magic: {path}",
+                                  kind="wal", path=path)
+        return
+    _entries, _valid_off, crc_failed_lsn = scan_wal(buf)
+    if crc_failed_lsn:
+        raise CorruptionError(
+            f"backup WAL entry lsn={crc_failed_lsn} crc mismatch: "
+            f"{path}", kind="wal", path=path)
+
+
+def _verify_backup_wal(dest: str):
+    """Backup-time gate: never archive corrupt WAL bytes — verify every
+    replica log in the copied tree, removing the half-made backup on
+    failure so a retry cannot resume from poison."""
+    try:
+        for dirpath, _dirs, files in os.walk(dest):
+            for f in files:
+                if f.startswith("replica_") and f.endswith(".log"):
+                    verify_wal_file(os.path.join(dirpath, f))
+    except CorruptionError:
+        shutil.rmtree(dest, ignore_errors=True)
+        raise
+
+
 def full_backup(db, dest: str) -> str:
     """Checkpoint + full copy; returns the backup dir."""
     if db.root is None:
@@ -47,6 +84,7 @@ def full_backup(db, dest: str) -> str:
     db.checkpoint()
     os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
     shutil.copytree(db.root, dest, dirs_exist_ok=False)
+    _verify_backup_wal(dest)
     files = _walk(dest)
     files.pop(MANIFEST, None)
     with open(os.path.join(dest, MANIFEST), "w") as fh:
@@ -80,6 +118,7 @@ def incremental_backup(db, dest: str, base: str) -> str:
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         shutil.copy2(src, dst)
         copied[rel] = size
+    _verify_backup_wal(dest)
     with open(os.path.join(dest, MANIFEST), "w") as fh:
         json.dump({"kind": "incremental", "base": os.path.abspath(base),
                    "ts": time.time(), "files": copied,
@@ -159,8 +198,12 @@ def pitr_cut(target: str, until_version: int):
     """Rewrite every WAL file under ``target`` dropping COMMIT records
     with version > until_version: transactions past the cut never
     replay, giving a consistent snapshot at the target point
-    (≙ restoring to a timestamp, src/storage/restore)."""
-    from oceanbase_tpu.palf.log import _HDR, _MAGIC, LogEntry
+    (≙ restoring to a timestamp, src/storage/restore).
+
+    Every entry's stored crc64 is VERIFIED before the rewrite: the cut
+    re-encodes entries, which would otherwise launder corrupt payloads
+    into fresh valid checksums the restored node then trusts."""
+    from oceanbase_tpu.palf.log import _MAGIC, LogEntry, scan_wal
 
     for dirpath, _dirs, files in os.walk(target):
         for f in files:
@@ -171,21 +214,23 @@ def pitr_cut(target: str, until_version: int):
                 buf = fh.read()
             if not buf.startswith(_MAGIC):
                 continue
-            off = len(_MAGIC)
+            entries, _valid_off, crc_failed_lsn = scan_wal(buf)
+            if crc_failed_lsn:
+                # a torn tail the boot scan would truncate is fine;
+                # a complete entry failing its crc is rot
+                raise CorruptionError(
+                    f"PITR source WAL entry lsn={crc_failed_lsn} crc "
+                    f"mismatch: {path}", kind="wal", path=path)
             kept: list[LogEntry] = []
-            while off + _HDR.size <= len(buf):
-                term, lsn, plen, _crc = _HDR.unpack_from(buf, off)
-                off += _HDR.size
-                payload = buf[off:off + plen]
-                off += plen
+            for e in entries:
                 try:
-                    rec = json.loads(payload.decode())
+                    rec = json.loads(e.payload.decode())
                 except Exception:
                     rec = {}
                 if rec.get("op") == "commit" and \
                         rec.get("version", 0) > until_version:
                     continue  # drop: this tx commits after the cut
-                kept.append(LogEntry(term, lsn, payload))
+                kept.append(e)
             # re-number LSNs densely (accept() requires a gapless log)
             tmp = path + ".tmp"
             with open(tmp, "wb") as fh:

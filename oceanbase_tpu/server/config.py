@@ -192,6 +192,23 @@ DEF("enable_auto_rebuild", True, "bool",
 DEF("rebuild_chunk_bytes", 4 << 20, "cap",
     "byte budget per rebuild.fetch_segments chunk", _pos)
 
+# data integrity / scrub (storage/scrub.py, storage/integrity.py)
+DEF("enable_scrub", True, "bool",
+    "background scrubber: periodically re-read + checksum-verify every "
+    "persisted segment, compare per-table logical digests across "
+    "replicas (scrub.checksum verb, majority wins), and auto-repair "
+    "corrupt/minority tables from a healthy peer over the chunked "
+    "rebuild.fetch_* verbs (≙ replica checksum verification at major "
+    "freeze) — surfaced as gv$scrub")
+DEF("scrub_interval_s", 300.0, "float",
+    "scrub round cadence; each round re-reads local segment files and "
+    "exchanges per-table digests with peers — hot-reloadable (the loop "
+    "re-reads it every wait)", _pos)
+DEF("enable_disk_faults", False, "bool",
+    "allow fault.inject where='disk' rules (seeded bitflip/truncate of "
+    "just-persisted segment/manifest/slog/wal files) to arm on this "
+    "node — the deterministic media-rot half of the chaos plane")
+
 # tenants / resources
 DEF("tenant_cpu_quota", 4, "int", "worker threads per tenant unit", _pos)
 DEF("tenant_memory_limit", 4 << 30, "cap",

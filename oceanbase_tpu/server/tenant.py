@@ -26,12 +26,16 @@ from oceanbase_tpu.tx.service import TransService
 
 class Tenant:
     def __init__(self, name: str, root: str | None, cluster_config: Config,
-                 wal_replicas: int = 3, wal=None, recovery=None):
+                 wal_replicas: int = 3, wal=None, recovery=None,
+                 corrupt_policy: str = "raise"):
         """``wal``: inject an external log handle (a NetPalf group whose
         replicas live in other OS processes, palf/netcluster.py) instead
         of the in-process PalfCluster — the multi-node path.
         ``recovery``: a shared RecoveryState (the node process passes its
-        own so rebuild + boot events land in one gv$recovery log)."""
+        own so rebuild + boot events land in one gv$recovery log).
+        ``corrupt_policy``: what boot does with a checksum-failing
+        segment — "raise" (no repair source) or "quarantine" (cluster
+        node; the scrub plane refetches from a peer)."""
         import time as _time
 
         from oceanbase_tpu.server import trace as qtrace
@@ -49,7 +53,8 @@ class Tenant:
         wal_dir = os.path.join(root, "wal") if root else None
         if wal_dir:
             os.makedirs(wal_dir, exist_ok=True)
-        self.engine = StorageEngine(data_dir)
+        self.engine = StorageEngine(data_dir,
+                                    corrupt_policy=corrupt_policy)
         if wal is not None:
             self.wal = wal
             local = wal.replica  # NetPalf: this process's replica

@@ -40,6 +40,7 @@ class VirtualTables:
             "gv$px_exchange": self.px_exchange,
             "gv$cluster_health": self.cluster_health,
             "gv$recovery": self.recovery,
+            "gv$scrub": self.scrub,
             "gv$trace": self.trace,
             "gv$active_session_history": self.active_session_history,
             "gv$system_event": self.wait_events,
@@ -372,6 +373,30 @@ class VirtualTables:
             "elapsed_s": np.array(
                 [r.get("elapsed_s", 0.0) for r in rows], np.float64),
             "note": _obj(r.get("note", "") for r in rows),
+        }
+
+    def scrub(self):
+        """Scrub-plane activity (storage/scrub.py): one row per event —
+        verify rounds (segments/bytes re-checked), quarantines,
+        cross-replica digest mismatches, repairs with their peer/bytes,
+        and post-repair parity checks (≙ the replica-checksum
+        verification surfaced by __all_virtual_tablet_checksum)."""
+        st = getattr(self.db, "scrub", None)
+        rows = st.rows() if st is not None else []
+        return {
+            "ts": np.array([r["ts"] for r in rows], np.float64),
+            "node_id": np.array([r["node_id"] for r in rows], np.int64),
+            "table_name": _obj(r["table"] for r in rows),
+            "phase": _obj(r["phase"] for r in rows),
+            "segments": np.array([r["segments"] for r in rows],
+                                 np.int64),
+            "bytes": np.array([r["bytes"] for r in rows], np.int64),
+            "peer": np.array([r["peer"] for r in rows], np.int64),
+            "mismatches": np.array([r["mismatches"] for r in rows],
+                                   np.int64),
+            "elapsed_s": np.array([r["elapsed_s"] for r in rows],
+                                  np.float64),
+            "note": _obj(r["note"] for r in rows),
         }
 
     def session_history(self):

@@ -38,10 +38,105 @@ class TableStore:
     tablet: Tablet  # single tablet per table in round 1; split comes with LS
 
 
+# ---------------------------------------------------------------------------
+# checksummed metadata files (manifest + slog) — module-level so the
+# rebuild client (net/rebuild.py) can pre-verify a baseline without an
+# engine instance
+# ---------------------------------------------------------------------------
+
+
+def load_manifest(path: str) -> dict:
+    """Read + verify a checkpoint manifest.  New files are
+    {"crc", "m"} with the crc over the sorted-key serialization of the
+    body; legacy (pre-integrity) files load unverified."""
+    from oceanbase_tpu.native import crc64
+    from oceanbase_tpu.storage.integrity import CorruptionError
+
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CorruptionError(f"manifest unreadable: {path} ({e})",
+                              kind="manifest", path=path) from e
+    if not isinstance(d, dict):
+        raise CorruptionError(f"manifest malformed: {path}",
+                              kind="manifest", path=path)
+    if "crc" not in d or "m" not in d:
+        return d  # legacy manifest
+    inner = json.dumps(d["m"], sort_keys=True)
+    if crc64(inner.encode()) != d["crc"]:
+        raise CorruptionError(f"manifest digest mismatch: {path}",
+                              kind="manifest", path=path)
+    return d["m"]
+
+
+def read_slog(path: str):
+    """Yield verified slog ops.  A torn FINAL line (crash mid-append) is
+    tolerated and ends the scan, exactly like the WAL torn-tail scan; a
+    checksum mismatch on a well-formed record is corruption and raises."""
+    from oceanbase_tpu.native import crc64
+    from oceanbase_tpu.storage.integrity import CorruptionError
+
+    with open(path) as f:
+        lines = f.readlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        last = i == len(lines) - 1
+        try:
+            d = json.loads(line)
+        except ValueError as e:
+            if last and not line.endswith("\n"):
+                return  # torn tail: the append never finished
+            raise CorruptionError(
+                f"slog record {i} unreadable: {path}",
+                kind="slog", path=path) from e
+        if isinstance(d, dict) and "rec" in d and "crc" in d:
+            if crc64(d["rec"].encode()) != d["crc"]:
+                raise CorruptionError(
+                    f"slog record {i} crc mismatch: {path}",
+                    kind="slog", path=path)
+            yield json.loads(d["rec"])
+        else:
+            yield d  # legacy unwrapped record
+
+
+def quarantine_file(path: str) -> str:
+    """Move a corrupt artifact aside (never delete — forensics) under a
+    unique .corrupt suffix, retention-capping the directory's older
+    quarantines by count/age; -> the quarantine path."""
+    import time
+
+    from oceanbase_tpu.storage.integrity import prune_quarantine
+
+    qpath = f"{path}.corrupt.{time.time_ns():x}"
+    os.replace(path, qpath)
+    prune_quarantine(os.path.dirname(qpath))
+    return qpath
+
+
 class StorageEngine:
-    def __init__(self, root: str | None = None):
+    def __init__(self, root: str | None = None,
+                 corrupt_policy: str = "raise"):
+        """``corrupt_policy`` decides what boot does with a segment file
+        that fails its checksum: ``"raise"`` (single node — no repair
+        source, fail loudly) or ``"quarantine"`` (cluster node — move
+        the file aside, boot without it, and let the scrub plane refetch
+        it from a healthy peer; storage/scrub.py)."""
         self.root = root
+        self.corrupt_policy = corrupt_policy
         self.tables: dict[str, TableStore] = {}
+        # segments quarantined at boot or by the scrubber, pending peer
+        # repair: [{"table", "segment_id", "part", "path"}]
+        self.quarantined: list[dict] = []
+        # scrub fast path: raw-file crc64 of fully verified segment
+        # files (path -> crc); a later round that re-reads identical
+        # bytes skips the decode-and-recheck
+        self._verified_files: dict[str, int] = {}
+        # disk-fault plane hook (net/faults.py FaultPlane or None):
+        # consulted AFTER every persistence write so seeded bitflip/
+        # truncate rules can target artifacts by kind
+        self.faults = None
         self.meta: dict = {}  # checkpointed runtime meta (wal replay point…)
         # table -> WAL LSN of the newest TRUNCATE whose slog record this
         # engine has already applied; WAL replay must not re-apply
@@ -68,15 +163,29 @@ class StorageEngine:
         return os.path.join(self.root, "manifest.json")
 
     def _log_meta(self, op: dict):
+        from oceanbase_tpu.native import crc64
+
         if self.ddl_wal_cb is not None:
             self.ddl_wal_cb(op)
         if self.root is None:
             return
         if self._slog_f is None:
             self._slog_f = open(self._slog_path(), "a")
-        self._slog_f.write(json.dumps(op) + "\n")
+        # each record ships as {"crc", "rec"} with the crc computed over
+        # the EXACT serialized op string — replay verifies before apply
+        # (≙ slog entry checksums)
+        rec = json.dumps(op)
+        self._slog_f.write(json.dumps(
+            {"crc": crc64(rec.encode()), "rec": rec}) + "\n")
         self._slog_f.flush()
         os.fsync(self._slog_f.fileno())
+        self._disk_fault("slog", self._slog_path())
+
+    def _disk_fault(self, kind: str, path: str):
+        """Consult the disk-fault plane after a persistence write (no-op
+        unless a NodeServer armed bitflip/truncate rules)."""
+        if self.faults is not None:
+            self.faults.act_disk(kind, path)
 
     def checkpoint(self):
         """Write an atomic manifest and truncate the slog
@@ -104,12 +213,19 @@ class StorageEngine:
                                  for s, part in
                                  ts.tablet.segment_locations()],
                 }
+            from oceanbase_tpu.native import crc64
+
+            # checkpoint digest: the manifest body travels beside a crc
+            # over its canonical (sorted-key) serialization; boot
+            # verifies before trusting the table/segment list
+            inner = json.dumps(m, sort_keys=True)
             tmp = self._manifest_path() + ".tmp"
             with open(tmp, "w") as f:
-                json.dump(m, f)
+                json.dump({"crc": crc64(inner.encode()), "m": m}, f)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self._manifest_path())
+            self._disk_fault("manifest", self._manifest_path())
             if self._slog_f:
                 self._slog_f.close()
                 self._slog_f = None
@@ -118,8 +234,7 @@ class StorageEngine:
     def _open_or_recover(self):
         mpath = self._manifest_path()
         if os.path.exists(mpath):
-            with open(mpath) as f:
-                m = json.load(f)
+            m = load_manifest(mpath)
             self.meta = m.get("meta", {})
             for name, t in m["tables"].items():
                 cols = [ColumnDef(n, SqlType(TypeKind(k), p, s), nl)
@@ -143,14 +258,35 @@ class StorageEngine:
                     part_idx = entry[2] if len(entry) > 2 else None
                     path = self._segment_file(name, seg_id)
                     if os.path.exists(path):
-                        ts.tablet.add_segment(Segment.load(path), part_idx)
+                        self._load_or_quarantine(name, seg_id, part_idx,
+                                                 path)
                 ts.tdef.row_count = ts.tablet.row_count_estimate()
-        # replay metadata ops logged after the checkpoint
+        # replay metadata ops logged after the checkpoint (each record
+        # crc-verified; a torn FINAL line is a crash artifact and
+        # truncates like a torn WAL tail, a bad crc anywhere is
+        # corruption and raises)
         if os.path.exists(self._slog_path()):
-            with open(self._slog_path()) as f:
-                for line in f:
-                    if line.strip():
-                        self._replay(json.loads(line))
+            for op in read_slog(self._slog_path()):
+                self._replay(op)
+
+    def _load_or_quarantine(self, table: str, seg_id: int, part_idx,
+                            path: str):
+        """Boot-time segment load honoring ``corrupt_policy``: a file
+        failing its checksum either fails the boot loudly or moves
+        aside so the scrub plane can refetch it from a peer."""
+        from oceanbase_tpu.storage.integrity import CorruptionError
+
+        ts = self.tables[table]
+        try:
+            ts.tablet.add_segment(Segment.load(path), part_idx)
+        except CorruptionError:
+            if self.corrupt_policy != "quarantine":
+                raise
+            qpath = quarantine_file(path)
+            with self._lock:  # reentrant: boot/replay callers hold it
+                self.quarantined.append(
+                    {"table": table, "segment_id": seg_id,
+                     "part": part_idx, "path": qpath})
 
     def _replay(self, op: dict):
         # boot-time today, but WAL catch-up may replay on a live engine;
@@ -223,19 +359,39 @@ class StorageEngine:
             if ts is not None:
                 path = self._segment_file(op["table"], op["segment_id"])
                 if os.path.exists(path):
-                    ts.tablet.add_segment(Segment.load(path),
-                                          op.get("part"))
+                    self._load_or_quarantine(op["table"],
+                                             op["segment_id"],
+                                             op.get("part"), path)
         elif kind == "replace_segments":
             ts = self.tables.get(op["table"])
             if ts is not None:
                 ts.tablet.remove_segments(op["removed"])
                 path = self._segment_file(op["table"], op["segment_id"])
                 if os.path.exists(path):
-                    ts.tablet.add_segment(Segment.load(path),
-                                          op.get("part"))
+                    self._load_or_quarantine(op["table"],
+                                             op["segment_id"],
+                                             op.get("part"), path)
+        elif kind == "repair_segments":
+            ts = self.tables.get(op["table"])
+            if ts is not None:
+                ts.tablet.remove_segments(op["removed"])
+                for sid, _level, part in op["installed"]:
+                    path = self._segment_file(op["table"], sid)
+                    if os.path.exists(path):
+                        self._load_or_quarantine(op["table"], sid, part,
+                                                 path)
 
     def _segment_file(self, table: str, seg_id: int) -> str:
         return os.path.join(self.root, "segments", f"{table}_{seg_id}.npz")
+
+    def _save_segment(self, table: str, seg) -> str:
+        """Persist one segment + consult the disk-fault plane (the ONE
+        place segment bytes hit disk, so bitflip rules by kind cover
+        every flush/compaction/load path)."""
+        path = self._segment_file(table, seg.segment_id)
+        seg.save(path)
+        self._disk_fault("segment", path)
+        return path
 
     # ------------------------------------------------------------------
     # DDL / load
@@ -362,8 +518,7 @@ class StorageEngine:
                             max_version=seg.max_version)
                         t.segments[i] = new
                         if self.root is not None:
-                            new.save(self._segment_file(
-                                name, new.segment_id))
+                            self._save_segment(name, new)
                 if log:
                     self._log_meta({"op": "alter_drop", "table": name,
                                     "column": cname})
@@ -632,7 +787,7 @@ class StorageEngine:
                     pv or None, min_version=version, max_version=version)
                 ts.tablet.add_segment(seg, part_idx)
                 if self.root is not None:
-                    seg.save(self._segment_file(name, seg.segment_id))
+                    self._save_segment(name, seg)
                     self._log_meta({"op": "add_segment", "table": name,
                                     "segment_id": seg.segment_id,
                                     "part": part_idx})
@@ -695,7 +850,7 @@ class StorageEngine:
             segs = self._new_segs(ts.tablet.mini_compact(snapshot))
             if self.root is not None:
                 for part, seg in segs:
-                    seg.save(self._segment_file(name, seg.segment_id))
+                    self._save_segment(name, seg)
                     self._log_meta({"op": "add_segment", "table": name,
                                     "segment_id": seg.segment_id,
                                     "part": part})
@@ -714,7 +869,7 @@ class StorageEngine:
                 removed = [i for i in old_ids if i not in after]
                 first = True
                 for part, seg in segs:
-                    seg.save(self._segment_file(name, seg.segment_id))
+                    self._save_segment(name, seg)
                     self._log_meta({"op": "replace_segments", "table": name,
                                     "segment_id": seg.segment_id,
                                     "part": part,
@@ -727,6 +882,131 @@ class StorageEngine:
 
     def major_compact(self, name: str):
         return self._compact(name, lambda lv: True, "major_compact")
+
+    # ------------------------------------------------------------------
+    # scrub plane hooks (storage/scrub.py drives these; ≙ the medium
+    # checker re-reading macro blocks + replica checksum repair)
+    # ------------------------------------------------------------------
+    def scrub_verify_table(self, table: str) -> dict:
+        """Re-read every persisted segment of ``table`` FROM DISK and
+        verify it (the in-memory copy may be healthy while the disk
+        bytes rot — exactly the failure scrub exists to catch).  A
+        corrupt file is quarantined and recorded in ``quarantined``;
+        the in-memory segment keeps serving until repair swaps the set,
+        so no read ever sees a missing-row window.
+
+        Cost shape: the FIRST verification of a file decodes and
+        re-checks every chunk/footer crc, then caches the raw file's
+        crc64; later rounds re-read the bytes (rot detection demands
+        it) but only crc the raw stream — full coverage at raw-IO cost,
+        which is what makes a continuous scrub cadence affordable.
+        -> {"checked", "bytes", "corrupt": [segment_id, ...]}"""
+        from oceanbase_tpu.native import crc64
+        from oceanbase_tpu.storage.integrity import CorruptionError
+
+        with self._lock:
+            ts = self.tables.get(table)
+            if ts is None or self.root is None:
+                return {"checked": 0, "bytes": 0, "corrupt": []}
+            locs = [(s.segment_id, part)
+                    for s, part in ts.tablet.segment_locations()]
+        checked, nbytes, corrupt = 0, 0, []
+        for seg_id, part in locs:
+            path = self._segment_file(table, seg_id)
+            try:
+                with open(path, "rb") as f:
+                    raw = f.read()
+            except OSError:
+                continue  # never persisted / already quarantined
+            checked += 1
+            nbytes += len(raw)
+            raw_crc = crc64(raw)
+            with self._lock:
+                known = self._verified_files.get(path)
+            if known == raw_crc:
+                continue  # bytes unchanged since full verification
+            try:
+                Segment.load(path)  # verify=True re-checks every crc
+                with self._lock:
+                    self._verified_files[path] = raw_crc
+            except CorruptionError:
+                with self._lock:
+                    self._verified_files.pop(path, None)
+                    if not os.path.exists(path):
+                        continue  # repaired/quarantined concurrently
+                    qpath = quarantine_file(path)
+                    self.quarantined.append(
+                        {"table": table, "segment_id": seg_id,
+                         "part": part, "path": qpath})
+                corrupt.append(seg_id)
+        return {"checked": checked, "bytes": nbytes, "corrupt": corrupt}
+
+    def rewrite_segment_from_memory(self, table: str, seg_id: int) -> bool:
+        """Peer-less repair: if the in-memory copy of a quarantined
+        segment is still resident (boot loaded it before the disk bytes
+        rotted), re-persist it.  The cluster path prefers a peer refetch
+        (storage/scrub.py) — this is the single-node fallback."""
+        with self._lock:
+            ts = self.tables.get(table)
+            if ts is None or self.root is None:
+                return False
+            for seg, _part in ts.tablet.segment_locations():
+                if seg.segment_id == seg_id:
+                    self._save_segment(table, seg)
+                    self.quarantined = [
+                        q for q in self.quarantined
+                        if not (q["table"] == table
+                                and q["segment_id"] == seg_id)]
+                    return True
+            return False
+
+    def repair_table_segments(self, table: str,
+                              installed: list[dict]) -> dict:
+        """Swap ``table``'s whole persisted+resident segment set for a
+        peer baseline already staged and VERIFIED on local disk
+        (storage/scrub.py downloads + checksums before calling).
+
+        ``installed``: [{"segment_id", "level", "part", "src"}] where
+        ``src`` is the staged file path.  Installed segments are
+        re-minted under FRESH local ids — peer ids live in the peer's
+        id space, and reusing them here could collide with local
+        history, breaking the segment-files-are-write-once invariant
+        incremental backups rely on.  Crash-safe order: files land
+        under their new names first, then ONE slog record publishes
+        the swap, then memory swaps and replaced files are deleted —
+        a crash between any two steps boots to either the old set
+        (fresh files orphaned) or the new set (replay applies the
+        record)."""
+        with self._lock:
+            ts = self.tables[table]
+            tab = ts.tablet
+            old_ids = [s.segment_id for s, _ in tab.segment_locations()]
+            segs = []
+            for ent in installed:
+                seg = Segment.load(ent["src"])
+                parts = getattr(tab, "partitions", None)
+                alloc = (parts[0] if parts else tab)._next_seg
+                seg.segment_id = next(alloc)
+                self._save_segment(table, seg)
+                os.remove(ent["src"])
+                segs.append((seg, ent.get("part")))
+            self._log_meta({
+                "op": "repair_segments", "table": table,
+                "removed": old_ids,
+                "installed": [[s.segment_id, s.level, p]
+                              for s, p in segs]})
+            tab.remove_segments(old_ids)
+            for s, p in segs:
+                tab.add_segment(s, p)
+            for sid in old_ids:
+                p = self._segment_file(table, sid)
+                if os.path.exists(p):
+                    os.remove(p)
+                self._verified_files.pop(p, None)
+            ts.tdef.row_count = tab.row_count_estimate()
+            self.quarantined = [q for q in self.quarantined
+                                if q["table"] != table]
+            return {"removed": len(old_ids), "installed": len(segs)}
 
 
 class StorageCatalog(Catalog):

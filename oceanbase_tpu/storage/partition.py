@@ -42,10 +42,11 @@ class PartitionedTablet:
             Tablet(tablet_id * 1000 + i, columns, types, key_cols)
             for i in range(len(bounds) + 1)
         ]
-        # one segment-id space across partitions (filenames stay unique)
-        import itertools
+        # one segment-id space across partitions (filenames stay unique;
+        # add_segment bumps it past recovered ids — see SegIdAlloc)
+        from oceanbase_tpu.storage.tablet import SegIdAlloc
 
-        shared = itertools.count(1)
+        shared = SegIdAlloc(1)
         for p in self.partitions:
             p._next_seg = shared
         self._lock = threading.RLock()

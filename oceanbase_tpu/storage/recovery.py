@@ -17,7 +17,11 @@ Phases recorded:
                      / ``rebuild.fetch_segments`` (peer, files, bytes);
 - ``checkpoint``     periodic replay-point advance (the O(tail) bound);
 - ``catchup``        live row: local apply point vs the group commit
-                     point (appended by the gv$recovery provider).
+                     point (appended by the gv$recovery provider);
+- ``quarantine``     corrupt persisted artifacts moved aside (bad-magic
+                     WAL files in palf/log.py — retention-capped by
+                     count/age — and digest-failing manifest/slog pairs
+                     in net/rebuild.py::quarantine_corrupt_baseline).
 """
 
 from __future__ import annotations

@@ -109,7 +109,15 @@ class Segment:
     # ------------------------------------------------------------------
     # persistence (≙ macro-block file + manifest entry)
     # ------------------------------------------------------------------
+    # Integrity layout: every (column, chunk) entry carries a crc64 over
+    # its encoded buffers + validity (≙ micro-block checksum), and the
+    # footer carries a whole-segment digest over the meta json — which
+    # transitively covers every chunk crc (≙ macro-block checksum).
+    # ``load`` verifies both and raises CorruptionError instead of
+    # decoding poisoned rows.
     def save(self, path: str):
+        from oceanbase_tpu.storage.integrity import chunk_crc
+
         payload = {}
         meta = {
             "segment_id": self.segment_id, "level": self.level,
@@ -124,6 +132,8 @@ class Segment:
             for i, ec in enumerate(chunks):
                 centry = {"encoding": ec.encoding, "n": ec.n,
                           "keys": list(ec.payload),
+                          "crc": chunk_crc(ec.payload, ec.valid,
+                                           ec.encoding, ec.n),
                           "zone": [None if ec.zone.vmin is None else
                                    _scalar(ec.zone.vmin),
                                    None if ec.zone.vmax is None else
@@ -137,37 +147,70 @@ class Segment:
                 meta["cols"][name].append(centry)
         import json
 
-        payload["__meta__"] = np.frombuffer(
-            json.dumps(meta).encode(), dtype=np.uint8)
+        from oceanbase_tpu.native import crc64
+
+        meta_json = json.dumps(meta).encode()
+        payload["__meta__"] = np.frombuffer(meta_json, dtype=np.uint8)
+        payload["__digest__"] = np.array([crc64(meta_json)],
+                                         dtype=np.uint64)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             np.savez_compressed(f, **payload)
         os.replace(tmp, path)  # atomic publish (≙ macro block seal)
 
     @staticmethod
-    def load(path: str) -> "Segment":
+    def load(path: str, verify: bool = True) -> "Segment":
         import json
 
         from oceanbase_tpu.datatypes import TypeKind
+        from oceanbase_tpu.native import crc64
         from oceanbase_tpu.storage.encoding import ZoneMap
+        from oceanbase_tpu.storage.integrity import (
+            CorruptionError,
+            chunk_crc,
+        )
 
-        with np.load(path, allow_pickle=True) as z:
-            meta = json.loads(bytes(z["__meta__"]).decode())
-            types = {n: SqlType(TypeKind(k), p, s)
-                     for n, (k, p, s) in meta["types"].items()}
-            cols = {}
-            for name, centries in meta["cols"].items():
-                chunks = []
-                for i, ce in enumerate(centries):
-                    payload = {k: z[f"{name}/{i}/{k}"] for k in ce["keys"]}
-                    valid = None
-                    if ce.get("has_valid"):
-                        valid = z[f"{name}/{i}/__valid__"]
-                    zn = ce["zone"]
-                    chunks.append(EncodedColumn(
-                        ce["encoding"], payload, valid,
-                        ZoneMap(zn[0], zn[1], zn[2], zn[3]), ce["n"]))
-                cols[name] = chunks
+        try:
+            with np.load(path, allow_pickle=True) as z:
+                meta_json = bytes(z["__meta__"])
+                meta = json.loads(meta_json.decode())
+                if verify and "__digest__" in z.files:
+                    if int(z["__digest__"][0]) != crc64(meta_json):
+                        raise CorruptionError(
+                            f"segment footer digest mismatch: {path}",
+                            kind="segment", path=path)
+                types = {n: SqlType(TypeKind(k), p, s)
+                         for n, (k, p, s) in meta["types"].items()}
+                cols = {}
+                for name, centries in meta["cols"].items():
+                    chunks = []
+                    for i, ce in enumerate(centries):
+                        payload = {k: z[f"{name}/{i}/{k}"]
+                                   for k in ce["keys"]}
+                        valid = None
+                        if ce.get("has_valid"):
+                            valid = z[f"{name}/{i}/__valid__"]
+                        if verify and "crc" in ce and \
+                                chunk_crc(payload, valid, ce["encoding"],
+                                          ce["n"]) != ce["crc"]:
+                            raise CorruptionError(
+                                f"segment chunk crc mismatch: {path} "
+                                f"column {name!r} chunk {i}",
+                                kind="segment", path=path)
+                        zn = ce["zone"]
+                        chunks.append(EncodedColumn(
+                            ce["encoding"], payload, valid,
+                            ZoneMap(zn[0], zn[1], zn[2], zn[3]), ce["n"]))
+                    cols[name] = chunks
+        except CorruptionError:
+            raise
+        except Exception as e:
+            # a flipped bit in the compressed container surfaces as a
+            # zip/zlib/json/key error long before any crc check runs —
+            # normalize to the ONE typed error read paths handle
+            raise CorruptionError(
+                f"segment unreadable: {path} ({e})",
+                kind="segment", path=path) from e
         return Segment(meta["segment_id"], meta["level"], meta["n_rows"],
                        cols, types, meta["min_version"], meta["max_version"])
 

@@ -24,6 +24,24 @@ from oceanbase_tpu.storage.memtable import MemTable
 from oceanbase_tpu.storage.segment import Segment, merge_segments
 
 
+class SegIdAlloc:
+    """Monotonic segment-id allocator that can be bumped past ids seen
+    on recovery/repair installs: a restarted tablet must never mint an
+    id that collides with a persisted segment file (the fresh segment
+    would silently overwrite the old one on disk)."""
+
+    def __init__(self, start: int = 1):
+        self.n = start
+
+    def __next__(self) -> int:
+        v = self.n
+        self.n += 1
+        return v
+
+    def bump_past(self, seg_id: int):
+        self.n = max(self.n, int(seg_id) + 1)
+
+
 class Tablet:
     def __init__(self, tablet_id: int, columns: list[str],
                  types: dict[str, SqlType], key_cols: list[str]):
@@ -35,7 +53,7 @@ class Tablet:
         self.frozen: list[MemTable] = []
         self.segments: list[Segment] = []   # oldest first
         self._next_mt = itertools.count(1)
-        self._next_seg = itertools.count(1)
+        self._next_seg = SegIdAlloc(1)
         self._lock = threading.RLock()
         self._auto_key = itertools.count()  # rowid for keyless tables
         self.data_version = 0               # bumps on any visible change
@@ -283,6 +301,7 @@ class Tablet:
         # lock; callers under the engine lock still must not bypass it
         with self._lock:
             self.segments.append(seg)
+            self._next_seg.bump_past(seg.segment_id)
             self.data_version += 1
 
     def remove_segments(self, ids):

@@ -15,7 +15,7 @@ Q1 answers, then replays the queries under three nemesis scenarios:
   nodekill    SIGKILL a data node while a query is in flight — the
               in-flight slice falls back to the coordinator's replica;
 
-then two recovery scenarios close the loop (PR 6):
+then recovery scenarios close the loop (PR 6):
 
   nodekill_restart   restart the SIGKILLed process: WAL replay + leader
                      catch-up + rejoin; the detector flips back to up,
@@ -26,6 +26,19 @@ then two recovery scenarios close the loop (PR 6):
   wipe_rebuild       empty the node's data dir: it bootstraps from a
                      peer checkpoint + segments + WAL over the chunked
                      rebuild.fetch_* verbs and reaches parity.
+
+and the silent-corruption scenario closes the integrity loop:
+
+  bitflip_scrub_repair   seeded bit flips rot THREE distinct persisted
+                     artifact kinds on one node — a segment file (one
+                     scrub.run round must detect → quarantine → repair
+                     it from a peer, gv$scrub tells the story), a WAL
+                     entry (restart: entry crc64 truncates the tail,
+                     leader catch-up re-ships), and the manifest
+                     (restart: digest check quarantines the baseline,
+                     full WAL replay + catch-up reconstruct) — after
+                     which the slice must be bit-identical to an
+                     independent sqlite oracle with 0 corrupt reads.
 
 Every query must return BIT-IDENTICAL rows to the fault-free baseline
 and finish inside the bench deadline (no query may ride a hung socket).
@@ -208,6 +221,65 @@ def run_queries(exec_fn, baseline, repeats):
 
 def p99(lat):
     return float(np.percentile(np.asarray(lat), 99)) if lat else 0.0
+
+
+def _round_rows(rows):
+    return [tuple(round(x, 9) if isinstance(x, float) else x
+                  for x in r) for r in rows]
+
+
+def glob_segments(node_root):
+    import glob as _glob
+
+    return _glob.glob(os.path.join(node_root, "data", "segments",
+                                   "lineitem_*.npz"))
+
+
+def flip_detectable(path):
+    """Seeded bit flip that provably lands in covered bytes (zip
+    alignment padding is don't-care; a flip there corrupts nothing)."""
+    from oceanbase_tpu.net.faults import bitflip_file
+    from oceanbase_tpu.storage.integrity import CorruptionError
+    from oceanbase_tpu.storage.segment import Segment
+
+    for seed in range(1, 64):
+        probe = path + ".probe"
+        shutil.copyfile(path, probe)
+        bitflip_file(probe, seed=seed)
+        try:
+            Segment.load(probe)
+        except CorruptionError:
+            os.remove(probe)
+            bitflip_file(path, seed=seed)
+            return seed
+        finally:
+            if os.path.exists(probe):
+                os.remove(probe)
+    raise AssertionError("no detectable flip found")
+
+
+def flip_wal_entry(path):
+    """Flip a payload bit of a COMPLETE mid-log entry (rot, not a torn
+    append): the boot scan must fail its crc64 and truncate there."""
+    from oceanbase_tpu.palf.log import _HDR, _MAGIC
+
+    with open(path, "rb") as f:
+        buf = f.read()
+    assert buf.startswith(_MAGIC)
+    offs = []
+    off = len(_MAGIC)
+    while off + _HDR.size <= len(buf):
+        _t, _l, plen, _c = _HDR.unpack_from(buf, off)
+        if off + _HDR.size + plen > len(buf):
+            break
+        offs.append(off + _HDR.size)
+        off += _HDR.size + plen
+    target = offs[len(offs) * 3 // 4]  # late entry: keep a replay prefix
+    with open(path, "r+b") as f:
+        f.seek(target)
+        b = f.read(1)
+        f.seek(target)
+        f.write(bytes([b[0] ^ 0x10]))
 
 
 def main():
@@ -452,6 +524,94 @@ def main():
             "rebuild_bytes": int(ev.get("rebuild", {}).get("bytes", 0)),
             "rebuild_files": int(ev.get("rebuild", {}).get("entries", 0)),
             "rebuild_peer": int(ev.get("rebuild", {}).get("peer", -1))}
+
+        # ---- scenario 6: seeded bit rot across 3 artifact kinds ----
+        # independent truth: the same slice queries through sqlite
+        import sqlite3
+
+        conn = sqlite3.connect(":memory:")
+        conn.execute(
+            "create table lineitem (l_id integer primary key,"
+            " l_quantity int, l_extendedprice int, l_discount int,"
+            " l_shipdate int, l_returnflag int, l_linestatus int)")
+        conn.executemany(
+            "insert into lineitem values (?,?,?,?,?,?,?)",
+            [(i, int(qty[i]), int(price[i]), int(disc[i]),
+              int(ship[i]), int(rf[i]), int(ls[i]))
+             for i in range(n_rows)])
+        oracle = {}
+        for name, q in QUERIES.items():
+            oracle[name] = [
+                tuple(round(x, 9) if isinstance(x, float) else x
+                      for x in r) for r in conn.execute(q).fetchall()]
+        conn.close()
+        assert all(_round_rows(baseline[k]) == oracle[k]
+                   for k in QUERIES), \
+            "fault-free baseline diverges from the sqlite oracle"
+
+        from oceanbase_tpu.net.faults import bitflip_file
+        from oceanbase_tpu.storage.integrity import CorruptionError
+        from oceanbase_tpu.storage.segment import Segment
+
+        n3 = os.path.join(root, "n3")
+        t0 = time.monotonic()
+        # (a) segment rot, repaired LIVE by one scrub round from a peer
+        seg = sorted(glob_segments(n3))[0]
+        flip_detectable(seg)
+        sres = clients[3].call("scrub.run")
+        seg_ok = bool(sres.get("corrupt")) and \
+            sres.get("repaired") == ["lineitem"] and not sres.get("failed")
+        gv = rows_of(clients[3].call(
+            "sql.execute", sql="select phase, bytes, peer from gv$scrub"
+            " where phase = 'repair'", consistency="weak"))
+        served = {k: _round_rows(rows_of(clients[3].call(
+            "sql.execute", sql=q, consistency="weak")))
+            for k, q in QUERIES.items()}
+        seg_parity = served == oracle
+        for p in glob_segments(n3):
+            Segment.load(p)  # mended files verify clean
+
+        # (b) WAL-entry rot + (c) manifest rot, repaired at RESTART
+        procs[3].send_signal(signal.SIGKILL)
+        procs[3].wait(timeout=10)
+        flip_wal_entry(os.path.join(n3, "wal", "replica_3.log"))
+        bitflip_file(os.path.join(n3, "data", "manifest.json"), seed=5)
+        start_node(3)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if clients[3].ping():
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("bit-rotted node 3 never came back")
+        if not wait_detector(c1, 3, ("up",), timeout=30):
+            raise TimeoutError("detector never flipped rotted node up")
+        wait_converged(clients, "lineitem", n_rows + 2)
+        rot_s = time.monotonic() - t0
+        rec = clients[3].call("recovery.state")
+        quar = [e for e in rec.get("events", [])
+                if e["phase"] == "quarantine"]
+        kinds_detected = {"segment"} if seg_ok else set()
+        for e in quar:
+            kinds_detected.add(
+                "wal" if "wal" in e.get("note", "") else "manifest")
+        served2 = {k: _round_rows(rows_of(clients[3].call(
+            "sql.execute", sql=q, consistency="weak")))
+            for k, q in QUERIES.items()}
+        parity, lat, hung = run_queries(sql, baseline, repeats=3)
+        out["scenarios"]["bitflip_scrub_repair"] = {
+            "parity": bool(seg_ok and seg_parity and parity
+                           and served2 == oracle
+                           and kinds_detected >=
+                           {"segment", "wal", "manifest"}),
+            "p99_s": round(p99(lat), 3), "queries": len(lat) + 4,
+            "hung": hung, "round_trip_s": round(rot_s, 2),
+            "kinds_detected": sorted(kinds_detected),
+            "scrub_repairs": len(gv),
+            "scrub_repair_bytes": sum(int(b) for _p, b, _pe in gv),
+            "scrub_repair_peer": int(gv[0][2]) if gv else -1,
+            "oracle_match": served2 == oracle,
+            "quarantine_events": len(quar)}
 
         out["parity_all"] = all(s["parity"]
                                 for s in out["scenarios"].values())

@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Scrub bench: the data-integrity plane's standing contract.
+
+Two halves, one dtl_bench-style JSON line:
+
+1. **Overhead** — the TPC-H slice (q6 + q1) on the leader of a live
+   3-node cluster, timed with the scrubber OFF vs ON at an aggressive
+   cadence (rounds re-reading every segment file + exchanging
+   cross-replica digests WHILE the queries run).  Contract: <= 2%
+   elapsed overhead — continuous verification must be effectively free.
+
+2. **Bitflip → repair round trip** — a seeded bit flip rots one
+   replica's segment file on disk; one scrub round must detect it,
+   quarantine the file, refetch the table from a healthy peer over the
+   chunked crc-verified rebuild verbs, and re-verify digest parity.
+   The round trip is timed and byte-accounted, and the slice queries on
+   the mended replica must return rows IDENTICAL to an independent
+   sqlite oracle — zero corrupt reads served.
+
+    python scripts/scrub_bench.py            # BENCH_ROWS=20000 default
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import socket
+import sqlite3
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+QUERIES = {
+    "q6": ("select sum(l_extendedprice * l_discount) from lineitem"
+           " where l_shipdate >= 8766 and l_shipdate < 9131"
+           " and l_discount >= 5 and l_discount <= 7"
+           " and l_quantity < 24"),
+    "q1": ("select l_returnflag, l_linestatus, sum(l_quantity),"
+           " sum(l_extendedprice), avg(l_discount), count(*)"
+           " from lineitem where l_shipdate <= 10000"
+           " group by l_returnflag, l_linestatus"
+           " order by l_returnflag, l_linestatus"),
+}
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _gen(n_rows, seed=1):
+    rng = np.random.default_rng(seed)
+    return {
+        "l_quantity": rng.integers(1, 50, n_rows),
+        "l_extendedprice": rng.integers(1000, 100000, n_rows),
+        "l_discount": rng.integers(0, 10, n_rows),
+        "l_shipdate": rng.integers(8766, 10227, n_rows),
+        "l_returnflag": rng.integers(0, 3, n_rows),
+        "l_linestatus": rng.integers(0, 2, n_rows),
+    }
+
+
+def _rows(res):
+    names = res["names"]
+    n = len(next(iter(res["arrays"].values()))) if names else 0
+    out = []
+    for r in range(n):
+        row = []
+        for nm in names:
+            v = res.get("valids", {}).get(nm)
+            if v is not None and not v[r]:
+                row.append(None)
+            else:
+                x = res["arrays"][nm][r]
+                x = x.item() if hasattr(x, "item") else x
+                row.append(round(x, 9) if isinstance(x, float) else x)
+        out.append(tuple(row))
+    return out
+
+
+def sqlite_oracle(cols, n_rows):
+    """The independent truth: the same slice queries through sqlite."""
+    conn = sqlite3.connect(":memory:")
+    conn.execute(
+        "create table lineitem (l_id integer primary key,"
+        " l_quantity int, l_extendedprice int, l_discount int,"
+        " l_shipdate int, l_returnflag int, l_linestatus int)")
+    conn.executemany(
+        "insert into lineitem values (?,?,?,?,?,?,?)",
+        [(i,) + tuple(int(cols[c][i]) for c in
+                      ("l_quantity", "l_extendedprice", "l_discount",
+                       "l_shipdate", "l_returnflag", "l_linestatus"))
+         for i in range(n_rows)])
+    out = {}
+    for name, q in QUERIES.items():
+        rows = conn.execute(q).fetchall()
+        out[name] = [tuple(round(x, 9) if isinstance(x, float) else x
+                           for x in r) for r in rows]
+    conn.close()
+    return out
+
+
+def boot_trio(root):
+    from oceanbase_tpu.net.node import NodeServer
+
+    ports = _free_ports(3)
+    nodes = {}
+    for i in range(1, 4):
+        peers = {j: ("127.0.0.1", ports[j - 1])
+                 for j in range(1, 4) if j != i}
+        nodes[i] = NodeServer(i, "127.0.0.1", ports[i - 1], peers,
+                              root=os.path.join(root, f"n{i}"),
+                              bootstrap=(i == 1), lease_ms=1500)
+    for n in nodes.values():
+        n.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            nodes[1].execute("select 1")
+            return nodes
+        except Exception:
+            time.sleep(0.3)
+    raise TimeoutError("cluster never elected a leader")
+
+
+def wait_converged(nodes, n_rows, timeout=120):
+    deadline = time.time() + timeout
+    for i in (2, 3):
+        while time.time() < deadline:
+            try:
+                r = nodes[i].execute("select count(*) from lineitem",
+                                     consistency="weak")
+                if int(r["arrays"][r["names"][0]][0]) == n_rows:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        else:
+            raise TimeoutError(f"node {i} never converged")
+
+
+def time_queries(node, repeats):
+    t0 = time.monotonic()
+    for _ in range(repeats):
+        for q in QUERIES.values():
+            node.execute(q)
+    return time.monotonic() - t0
+
+
+def main():
+    n_rows = int(os.environ.get("BENCH_ROWS", "20000"))
+    repeats = int(os.environ.get("BENCH_REPEATS", "32"))
+    root = tempfile.mkdtemp(prefix="scrubbench_")
+    out = {"metric": "scrub_bench", "rows": n_rows}
+    nodes = {}
+    try:
+        cols = _gen(n_rows)
+        oracle = sqlite_oracle(cols, n_rows)
+        nodes = boot_trio(root)
+        lead = nodes[1]
+        lead.execute(
+            "create table lineitem (l_id int primary key,"
+            " l_quantity int, l_extendedprice int, l_discount int,"
+            " l_shipdate int, l_returnflag int, l_linestatus int)")
+        t_load = time.monotonic()
+        for s in range(0, n_rows, 2000):
+            e = min(s + 2000, n_rows)
+            vals = ", ".join(
+                f"({i}, {cols['l_quantity'][i]},"
+                f" {cols['l_extendedprice'][i]},"
+                f" {cols['l_discount'][i]}, {cols['l_shipdate'][i]},"
+                f" {cols['l_returnflag'][i]}, {cols['l_linestatus'][i]})"
+                for i in range(s, e))
+            lead.execute(f"insert into lineitem values {vals}")
+        out["load_s"] = round(time.monotonic() - t_load, 2)
+        wait_converged(nodes, n_rows)
+        for n in nodes.values():
+            n.tenant.checkpoint()
+
+        # parity guard + jit warmup
+        for name, q in QUERIES.items():
+            assert _rows(lead.execute(q)) == oracle[name], \
+                f"{name} diverges from sqlite oracle pre-bench"
+        time_queries(lead, 3)
+
+        # ---- half 1: scrub-on vs scrub-off overhead ----------------
+        # aggressive cadence (150x the production default of 300 s) so
+        # rounds genuinely overlap the measured queries
+        for n in nodes.values():
+            n.config.set("scrub_interval_s", 2.0)
+        off_s = on_s = 0.0
+        blocks = 8
+        per_block = max(repeats // blocks, 1)
+        for b in range(blocks):
+            order = (False, True) if b % 2 == 0 else (True, False)
+            for mode in order:
+                for n in nodes.values():
+                    n.config.set("enable_scrub", mode)
+                dt = time_queries(lead, per_block)
+                if mode:
+                    on_s += dt
+                else:
+                    off_s += dt
+        for n in nodes.values():
+            n.config.set("enable_scrub", True)
+        scrub_rounds = sum(
+            1 for r in lead.scrubber.state.rows()
+            if r["phase"] == "verify")
+        overhead = (on_s - off_s) / off_s if off_s else 0.0
+        out["overhead"] = {
+            "off_s": round(off_s, 3), "on_s": round(on_s, 3),
+            "overhead_pct": round(overhead * 100, 2),
+            "scrub_rounds_leader": scrub_rounds,
+            "queries": per_block * blocks * 2 * len(QUERIES),
+            "pass": overhead <= 0.02}
+
+        # ---- half 2: seeded bitflip -> detect/quarantine/repair ----
+        from oceanbase_tpu.net.faults import bitflip_file
+        from oceanbase_tpu.storage.integrity import CorruptionError
+        from oceanbase_tpu.storage.segment import Segment
+
+        victim = nodes[3]
+        seg_files = glob.glob(os.path.join(
+            victim.root, "data", "segments", "lineitem_*.npz"))
+        flipped = None
+        for seed in range(1, 64):
+            probe = seg_files[0] + ".probe"
+            shutil.copyfile(seg_files[0], probe)
+            bitflip_file(probe, seed=seed)
+            try:
+                Segment.load(probe)
+            except CorruptionError:
+                bitflip_file(seg_files[0], seed=seed)
+                flipped = seed
+            finally:
+                os.remove(probe)
+            if flipped:
+                break
+        assert flipped, "no detectable flip found"
+        t0 = time.monotonic()
+        s = victim.scrubber.run_once()
+        repair_s = time.monotonic() - t0
+        repair_rows = [r for r in victim.scrubber.state.rows()
+                       if r["phase"] == "repair"]
+        served = {name: _rows(victim.execute(q, consistency="weak"))
+                  for name, q in QUERIES.items()}
+        oracle_match = served == oracle
+        for p in glob.glob(os.path.join(victim.root, "data", "segments",
+                                        "lineitem_*.npz")):
+            Segment.load(p)  # the mended files verify clean
+        out["repair"] = {
+            "seed": flipped,
+            "detected": bool(s["corrupt"]),
+            "repaired": s["repaired"],
+            "failed": s["failed"],
+            "round_trip_s": round(repair_s, 3),
+            "repair_bytes": sum(r["bytes"] for r in repair_rows),
+            "repair_peer": repair_rows[-1]["peer"] if repair_rows else -1,
+            "oracle_match": oracle_match,
+            "pass": bool(s["corrupt"] and s["repaired"] == ["lineitem"]
+                         and not s["failed"] and oracle_match)}
+
+        out["pass"] = bool(out["overhead"]["pass"]
+                           and out["repair"]["pass"])
+        from oceanbase_tpu.server import metrics as qmetrics
+
+        out["sysstat"] = {k: v for k, v in
+                          sorted(qmetrics.sysstat_dict().items())
+                          if k.startswith("scrub.")}
+        print(json.dumps(out))
+        if not out["pass"]:
+            sys.exit(1)
+    finally:
+        for n in nodes.values():
+            try:
+                n.stop()
+            except Exception:
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -277,20 +277,41 @@ def test_torn_tail_corrupt_crc(tmp_path):
 
 
 def test_unreadable_log_quarantined(tmp_path):
-    """A log with a foreign magic is moved aside, never appended after."""
+    """A log with a foreign magic is moved aside (uniquely named,
+    surfaced to gv$recovery, retention-capped), never appended after."""
+    from oceanbase_tpu.storage.recovery import RecoveryState
+
     d = str(tmp_path)
     path = os.path.join(d, "replica_1.log")
     with open(path, "wb") as f:
         f.write(b"NOTMAGIC" + b"\x00" * 64)
-    r = PalfReplica(1, d)
+    rec = RecoveryState(1)
+    r = PalfReplica(1, d, recovery=rec)
     assert r.entries == []
     r.role = "leader"
     r.leader_append([b"x"])
     r.close()
-    assert os.path.exists(path + ".corrupt")
+    corrupt = [n for n in os.listdir(d) if ".corrupt" in n]
+    assert len(corrupt) == 1
+    ev = rec.last("quarantine")
+    assert ev is not None and ev["bytes"] == 72
     r2 = PalfReplica(1, d)
     assert [e.payload for e in r2.entries] == [b"x"]
     r2.close()
+
+
+def test_quarantine_retention_capped(tmp_path):
+    """Repeated quarantines never grow the log dir unbounded."""
+    from oceanbase_tpu.palf.log import QUARANTINE_KEEP
+
+    d = str(tmp_path)
+    path = os.path.join(d, "replica_1.log")
+    for _ in range(QUARANTINE_KEEP + 3):
+        with open(path, "wb") as f:
+            f.write(b"NOTMAGIC" + b"\x00" * 16)
+        PalfReplica(1, d).close()
+    corrupt = [n for n in os.listdir(d) if ".corrupt" in n]
+    assert 1 <= len(corrupt) <= QUARANTINE_KEEP
 
 
 def test_follower_accept_after_torn_tail(tmp_path):
