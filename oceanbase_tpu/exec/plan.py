@@ -768,6 +768,12 @@ def execute_plan(plan: PlanNode, tables: dict[str, Relation],
     (join expansion, exchange buffer) overflowed — results would be
     silently truncated otherwise; the caller re-plans with larger budgets.
     """
+    # cancel/deadline checkpoint (server/admission.py): host-side, at
+    # the plan boundary only — never inside the jit-traced body, so
+    # KILL/query_timeout_s observe here without touching compile keys
+    from oceanbase_tpu.server import admission as qadmission
+
+    qadmission.checkpoint()
     key = plan.fingerprint()
     needed = referenced_tables(plan)
     with_monitor = monitor_out is not None
@@ -856,4 +862,7 @@ def execute_plan(plan: PlanNode, tables: dict[str, Relation],
                 f"operator capacity exceeded ({detail} rows dropped); "
                 f"re-plan with larger out_capacity", drops=drops,
             )
+    # operator-close checkpoint: a killed/expired statement unwinds at
+    # the result boundary instead of riding out the rest of the plan
+    qadmission.checkpoint()
     return out

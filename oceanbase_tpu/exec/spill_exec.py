@@ -300,7 +300,14 @@ def _dead_granule(types: dict, gdicts: dict, chunk_rows: int):
 
 
 def _host_batch(ctx: _Ctx, rel: Relation):
-    """Device relation -> one host (arrays, valids) batch (live rows)."""
+    """Device relation -> one host (arrays, valids) batch (live rows).
+
+    Every produced batch funnels through here, which makes it the
+    spill tier's per-chunk cancel/deadline checkpoint: KILL and
+    query_timeout_s observe between chunk programs, host-side."""
+    from oceanbase_tpu.server import admission as qadmission
+
+    qadmission.checkpoint()
     host = to_numpy(rel)
     cols = [c for c in host if not c.startswith("__valid__")]
     if not cols:

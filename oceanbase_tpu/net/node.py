@@ -88,6 +88,14 @@ class NodeDatabase:
         self.dtl = None  # DtlExchange, installed by NodeServer
         self.health = None  # HealthMonitor, installed by NodeServer
         self.scrub = None  # ScrubState, installed by NodeServer
+        # overload plane: statement admission + KILL for the sessions
+        # this node's wire threads run (one sys tenant per node)
+        from oceanbase_tpu.server.admission import AdmissionController
+
+        self.admission = AdmissionController(
+            self.config,
+            weight_of=lambda name: int(
+                self.config["admission_tenant_weight"]))
         self.virtual_tables = VirtualTables(self)
         self._session_ids = itertools.count(1)
 
@@ -138,9 +146,11 @@ class NodeServer:
         # receives consults it (seeded — nemesis schedules replay)
         self.faults = FaultPlane(seed=int(self.config["fault_seed"]))
         pool = int(self.config["rpc_conn_pool_size"])
+        max_conns = int(self.config["rpc_max_conns_per_peer"])
         self.peers = {pid: RpcClient(h, p, peer_id=pid,
                                      local_id=node_id,
-                                     faults=self.faults, pool_size=pool)
+                                     faults=self.faults, pool_size=pool,
+                                     max_conns=max_conns)
                       for pid, (h, p) in peers.items()}
         self._apply_lock = threading.RLock()
 
@@ -219,11 +229,15 @@ class NodeServer:
 
         self.scrubber = Scrubber(self)
         self.db.scrub = self.scrubber.state
+        from oceanbase_tpu.px.dtl import CancelRegistry
+
+        self.dtl_cancels = CancelRegistry()
         handlers = {
             "ping": lambda: "pong",
             "das.scan": self._h_scan,
             "das.pull": self._h_pull,
             "dtl.execute": self._h_dtl_execute,
+            "dtl.cancel": self._h_dtl_cancel,
             "sql.execute": self._h_execute,
             "node.state": self._h_state,
             "cluster.health": self._h_health,
@@ -392,10 +406,20 @@ class NodeServer:
         return {"rows": n, "snapshot": snap,
                 "bytes": stats.get("bytes", 0), "node": self.node_id}
 
+    def _h_dtl_cancel(self, token: str):
+        """Idempotent fragment cancellation (the remote half of KILL /
+        query timeout): set — or tombstone — the cancel flag for
+        ``token``; a running fragment observes it at its next host-side
+        result-boundary checkpoint, a late-arriving one aborts before
+        scanning anything."""
+        return {"already": self.dtl_cancels.cancel(str(token)),
+                "node_id": self.node_id}
+
     def _h_dtl_execute(self, plan: dict, table: str, snapshot: int,
                        part: int = 0, nparts: int = 1,
                        applied_lsn: int = 0, with_ops: bool = False,
-                       monitor_lanes: bool = False):
+                       monitor_lanes: bool = False,
+                       cancel_token: str = ""):
         """Execute one DTL partial-plan slice against the local replica
         (≙ the SQC running its DFO over local tablets and streaming
         exchange rows back; px/dtl.py holds the plan wire codec).
@@ -416,18 +440,37 @@ class NodeServer:
                 f"{self.palf.replica.applied_lsn} < {applied_lsn}")
         from oceanbase_tpu.server import trace as qtrace
 
+        # coordinator-propagated cancellation: the fragment runs under a
+        # RemoteCtx observing the token's flag, so execute_plan's
+        # result-boundary checkpoints stop remote work too (and a
+        # tombstoned token aborts before scanning anything)
+        from oceanbase_tpu.server import admission as qadmission
+
+        rctx = None
+        if cancel_token:
+            ev = self.dtl_cancels.entry(str(cancel_token))
+            if ev.is_set():
+                raise qadmission.QueryKilled(
+                    f"fragment {cancel_token} cancelled before start")
+            rctx = qadmission.RemoteCtx(ev, token=str(cancel_token))
         # monitor_lanes is the COORDINATOR's monitor-knob state: it
         # picks the fragment executable variant here, so the per-query
         # sampling decision (with_ops) never alternates the compile key
-        # (see dtl.execute_fragment's monitor_lanes contract)
-        with qtrace.span("dtl.fragment", table=table,
-                         part=int(part)) as sp:
-            out = dtl.execute_fragment(ts, plan, int(snapshot),
-                                       int(part), int(nparts),
-                                       with_ops=bool(with_ops),
-                                       monitor_lanes=bool(monitor_lanes))
-            sp.tags.update(rows=out["rows"], scanned=out["scanned"])
-            return out
+        # (see dtl.execute_fragment's monitor_lanes contract).
+        # A local (coordinator-thread) call arrives WITHOUT a token and
+        # must keep the statement's own ctx active — never mask it.
+        import contextlib
+
+        with (qadmission.activate(rctx) if rctx is not None
+              else contextlib.nullcontext()):
+            with qtrace.span("dtl.fragment", table=table,
+                             part=int(part)) as sp:
+                out = dtl.execute_fragment(
+                    ts, plan, int(snapshot), int(part), int(nparts),
+                    with_ops=bool(with_ops),
+                    monitor_lanes=bool(monitor_lanes))
+                sp.tags.update(rows=out["rows"], scanned=out["scanned"])
+                return out
 
     def _h_execute(self, sql: str, consistency: str = "strong",
                    session_id: int = 0, forwarded: bool = False):

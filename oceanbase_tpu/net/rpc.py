@@ -88,6 +88,13 @@ class DeadlineExceeded(TimeoutError):
     failure path treats it as the network fault it is."""
 
 
+class ConnPoolExhausted(DeadlineExceeded):
+    """Checkout hit the per-peer connection cap (rpc_max_conns_per_peer)
+    and no socket freed inside the call's remaining deadline — the
+    typed fail-fast for fan-out overload, instead of dialing without
+    bound."""
+
+
 # ---------------------------------------------------------------------------
 # per-verb deadline / retry policy table (≙ the proxy stubs' timeout +
 # OB_RPC_NEED_RETRY discipline, declared per verb instead of per call site)
@@ -145,6 +152,10 @@ POLICIES: dict[str, VerbPolicy] = {
     # so both carry bounded retry budgets
     "scrub.checksum": VerbPolicy(60.0, True, 2, 0.05, 0.50),
     "scrub.run":      VerbPolicy(300.0, True, 1, 0.10, 1.00),
+    # dtl.cancel sets a cancel flag keyed by statement token — setting
+    # an already-set flag is a no-op, trivially idempotent; it must
+    # fail FAST (the canceller is usually unwinding a kill/timeout)
+    "dtl.cancel":   VerbPolicy(2.0, True, 2, 0.02, 0.20),
     "sql.execute":  VerbPolicy(600.0, False),
 }
 
@@ -250,8 +261,14 @@ class _Handler(socketserver.BaseRequestHandler):
                     resp = {"rid": rid, "ok": True, "result": result}
                     qmetrics.inc("rpc.served", verb=str(verb), ok=1)
                 except Exception as e:  # noqa: BLE001 — ship to caller
+                    # a handler that FORWARDED (sql.execute routing)
+                    # re-raises an RpcError: preserve the original
+                    # remote kind across the extra hop instead of
+                    # collapsing every typed error to "RpcError"
+                    kind = e.kind if isinstance(e, RpcError) \
+                        else type(e).__name__
                     resp = {"rid": rid, "ok": False,
-                            "error_kind": type(e).__name__,
+                            "error_kind": kind,
                             "error": str(e)}
                     qmetrics.inc("rpc.served", verb=str(verb), ok=0)
                 if tctx is not None and tctx.spans:
@@ -317,45 +334,90 @@ class RpcClient:
 
     def __init__(self, host: str, port: int, timeout_s: float = 10.0,
                  peer_id: int | None = None, local_id: int | None = None,
-                 faults=None, observer=None, pool_size: int = 4):
+                 faults=None, observer=None, pool_size: int = 4,
+                 max_conns: int = 16):
         self.addr = (host, port)
         self.timeout_s = timeout_s  # connect timeout + policy fallback
         self.peer_id = peer_id
         self.local_id = local_id
         self.faults = faults
         self.observer = observer
-        self._pool: list[socket.socket] = []
-        self._pool_size = pool_size
+        self._pool: list[socket.socket] = []   # idle; MRU at the end
+        self._pool_size = pool_size            # idle cap (LRU closes)
+        self._max_conns = max(max_conns, 1)    # live cap (idle+in-use)
+        self._conns = 0                        # live sockets accounted
         self._rid = itertools.count(1)
-        self._lock = threading.Lock()  # guards the pool list only
+        # guards pool list + live-socket count; waiters park on it when
+        # checkout hits the live cap
+        self._lock = threading.Condition()
 
     # -- pool ----------------------------------------------------------
+    def _discard(self, s: socket.socket):
+        """Close a socket this client accounted for (failure paths, LRU
+        eviction) and wake a capped-out checkout waiter."""
+        try:
+            s.close()
+        except OSError:
+            pass
+        with self._lock:
+            self._conns = max(self._conns - 1, 0)
+            self._lock.notify()
+
     def _checkout(self, timeout: float) -> socket.socket:
+        deadline = time.monotonic() + timeout
         while True:
             with self._lock:
                 s = self._pool.pop() if self._pool else None
-            if s is None:
-                s = socket.create_connection(
-                    self.addr, timeout=min(timeout, self.timeout_s))
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                break
+                if s is None:
+                    if self._conns < self._max_conns:
+                        # reserve the live-cap seat before the (slow,
+                        # unlocked) dial; released on dial failure
+                        self._conns += 1
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise ConnPoolExhausted(
+                            f"{self.addr}: {self._max_conns} "
+                            f"connections busy, none freed inside "
+                            f"{timeout:.3f}s")
+                    self._lock.wait(timeout=min(remaining, 0.05))
+                    continue
             # an idle request/response socket should never be readable;
             # readable means the peer closed it (or sent garbage) while
             # pooled — discard instead of letting a doomed send turn
             # into a spurious "may have executed" on non-idempotent work
             r, _, _ = select.select([s], [], [], 0)
             if not r:
-                break
-            s.close()
+                s.settimeout(timeout)
+                return s
+            self._discard(s)
+        try:
+            s = socket.create_connection(
+                self.addr, timeout=min(timeout, self.timeout_s))
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except BaseException:
+            with self._lock:
+                self._conns = max(self._conns - 1, 0)
+                self._lock.notify()
+            raise
         s.settimeout(timeout)
         return s
 
     def _checkin(self, s: socket.socket):
+        extras: list[socket.socket] = []
         with self._lock:
-            if len(self._pool) < self._pool_size:
-                self._pool.append(s)
-                return
-        s.close()
+            self._pool.append(s)
+            # idle cap: close the LEAST-recently-used extras (index 0),
+            # keeping the warm end of the pool
+            while len(self._pool) > max(self._pool_size, 0):
+                extras.append(self._pool.pop(0))
+                self._conns = max(self._conns - 1, 0)
+            self._lock.notify()
+        for e in extras:
+            try:
+                e.close()
+            except OSError:
+                pass
 
     # -- calls ---------------------------------------------------------
     def call(self, method: str, _deadline_s: float | None = None,
@@ -463,7 +525,7 @@ class RpcClient:
                 # close it (never back to the pool) so the next attempt
                 # reconnects cleanly
                 if conn is not None:
-                    conn.close()
+                    self._discard(conn)
                 now = time.monotonic()
                 if tspan is not None:
                     # failed attempts must still attribute their retry
@@ -531,6 +593,8 @@ class RpcClient:
         next call dials fresh, matching the old reconnect semantics)."""
         with self._lock:
             pool, self._pool = self._pool, []
+            self._conns = max(self._conns - len(pool), 0)
+            self._lock.notify_all()
         for s in pool:
             try:
                 s.close()

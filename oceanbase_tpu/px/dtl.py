@@ -74,8 +74,51 @@ qmetrics.declare("dtl.digest_mismatches", "counter",
 DTL_TABLE = "__dtl_recv__"
 
 
+qmetrics.declare("dtl.cancels", "counter",
+                 "dtl.cancel flags observed (sent or received)")
+
+
 class NotPushable(Exception):
     """Plan/expr shape the DTL wire codec does not cover."""
+
+
+class CancelRegistry:
+    """Per-node registry of in-flight fragment cancel flags, keyed by
+    the coordinator's statement token (StmtCtx.token).
+
+    ``dtl.cancel`` is IDEMPOTENT: cancelling an unknown token plants a
+    tombstone (the flag, pre-set), so a fragment racing in later — or a
+    resent cancel after a lost reply — converges on the same state.
+    Bounded LRU so tombstones of statements that never arrive cannot
+    grow the map without bound."""
+
+    MAX_ENTRIES = 1024
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[str, threading.Event]" \
+            = collections.OrderedDict()
+
+    def entry(self, token: str) -> threading.Event:
+        """The cancel flag for ``token`` (created unset on first use)."""
+        with self._lock:
+            ev = self._entries.get(token)
+            if ev is None:
+                while len(self._entries) >= self.MAX_ENTRIES:
+                    self._entries.popitem(last=False)
+                ev = self._entries[token] = threading.Event()
+            else:
+                self._entries.move_to_end(token)
+            return ev
+
+    def cancel(self, token: str) -> bool:
+        """Set the flag (planting it if unknown).  -> was it already
+        set?  Re-application is a no-op — the verb's idempotence."""
+        ev = self.entry(token)
+        already = ev.is_set()
+        ev.set()
+        qmetrics.inc("dtl.cancels")
+        return already
 
 
 class DtlLagging(RuntimeError):
@@ -720,6 +763,13 @@ class DtlExchange:
         lsn = node.palf.replica.applied_lsn
         t0 = time.time()       # record timestamp (wall)
         m0 = time.monotonic()  # elapsed source (step-proof)
+        # cancel correlation: remote fragments register under the
+        # statement's token so a KILL/timeout on the coordinator can
+        # stop in-flight remote work via the idempotent dtl.cancel verb
+        from oceanbase_tpu.server import admission as qadmission
+
+        _ctx = qadmission.current()
+        cancel_token = _ctx.token if _ctx is not None else ""
         results: list = [None] * nparts
         ship_bytes = [0] * nparts
         slice_s = [0.0] * nparts
@@ -751,7 +801,8 @@ class DtlExchange:
                                 table=push.table, snapshot=snap,
                                 part=i, nparts=nparts,
                                 applied_lsn=lsn, with_ops=want_ops,
-                                monitor_lanes=want_lanes)
+                                monitor_lanes=want_lanes,
+                                cancel_token=cancel_token)
                             verify_reply(res, i, cli.peer_id)
                             results[i] = res
                             ship_bytes[i] = sent + recv
@@ -762,20 +813,47 @@ class DtlExchange:
             threads = [threading.Thread(target=run_peer, args=(i, cli),
                                         daemon=True)
                        for i, cli in remote]
-            for t in threads:
-                t.start()
-            # the coordinator's own slice — and every slice routed away
-            # from an unhealthy peer — runs locally while peers work
-            for i in avoided_parts:
-                with qtrace.span("dtl.slice", part=i, local=1):
-                    s0 = time.monotonic()
-                    results[i] = node._h_dtl_execute(
-                        plan=push.encoded, table=push.table,
-                        snapshot=snap, part=i, nparts=nparts,
-                        with_ops=want_ops, monitor_lanes=want_lanes)
-                    slice_s[i] = time.monotonic() - s0
-            for t in threads:
-                t.join()
+
+            def _cancel_remote():
+                # best-effort, idempotent: stop in-flight remote
+                # fragments; a peer that already finished (or never
+                # got the fragment) just plants a tombstone
+                for _i, cli in remote:
+                    try:
+                        cli.call("dtl.cancel", token=cancel_token)
+                    except Exception:  # noqa: BLE001 — unwinding
+                        pass
+
+            try:
+                for t in threads:
+                    t.start()
+                # the coordinator's own slice — and every slice routed
+                # away from an unhealthy peer — runs locally while
+                # peers work
+                for i in avoided_parts:
+                    with qtrace.span("dtl.slice", part=i, local=1):
+                        s0 = time.monotonic()
+                        results[i] = node._h_dtl_execute(
+                            plan=push.encoded, table=push.table,
+                            snapshot=snap, part=i, nparts=nparts,
+                            with_ops=want_ops,
+                            monitor_lanes=want_lanes)
+                        slice_s[i] = time.monotonic() - s0
+                # slice-join checkpoint loop: instead of a blind join,
+                # poll so a KILL/timeout on the coordinator unwinds
+                # NOW and cancels the in-flight remote fragments
+                while any(t.is_alive() for t in threads):
+                    for t in threads:
+                        t.join(0.05)
+                        if t.is_alive():
+                            break
+                    qadmission.checkpoint()
+                for t in threads:
+                    t.join()
+            except (qadmission.QueryKilled, qadmission.QueryTimeout):
+                if cancel_token and remote:
+                    _cancel_remote()
+                raise
             fallbacks = 0
             from oceanbase_tpu.net.rpc import RpcError
 

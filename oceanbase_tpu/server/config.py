@@ -116,7 +116,52 @@ DEF("shape_bucket_floor", 64, "int",
     "smallest capacity bucket (tables below it pad up to the floor); "
     "governs storage materialization — derived chunk/exchange budgets "
     "use the default ladder", _pos)
-DEF("query_timeout_s", 3600, "int", "per-statement timeout seconds", _pos)
+DEF("query_timeout_s", 3600, "int",
+    "per-statement deadline seconds (settable per session via SET "
+    "query_timeout_s); checked host-side at result-boundary "
+    "checkpoints — operator close, spill chunk, DTL slice join, the "
+    "capacity-retry ladder — raising typed QueryTimeout", _pos)
+
+# overload robustness: statement admission + fair queuing
+# (server/admission.py)
+DEF("enable_admission", True, "bool",
+    "statement admission control: queries/DML check a per-tenant slot "
+    "out before binding; over-limit statements wait in a bounded "
+    "per-tenant FIFO granted by weighted round-robin across tenants, "
+    "full queues reject fast with typed ServerBusy (≙ the tenant "
+    "worker quota + large query queue)")
+DEF("admission_slots", 32, "int",
+    "process-wide concurrent admitted statements (0 disables "
+    "admission)", _nonneg)
+DEF("admission_tenant_slots", 16, "int",
+    "per-tenant cap on concurrently admitted statements", _pos)
+DEF("admission_queue_limit", 64, "int",
+    "bounded per-tenant admission FIFO depth; statements beyond it "
+    "reject immediately with ServerBusy", _nonneg)
+DEF("admission_queue_timeout_s", 10.0, "float",
+    "queue-wait budget before a queued statement gives up with "
+    "ServerBusy (also clamped to the statement's own deadline)", _pos)
+DEF("admission_tenant_weight", 1, "int",
+    "weighted-round-robin share of this tenant's queue when admission "
+    "slots free up (set on the tenant's config overlay)", _pos)
+DEF("large_query_threshold_s", 5.0, "float",
+    "observed runtime past which a statement yields its normal "
+    "admission slot to the low-priority large-query lane at its next "
+    "checkpoint (point queries stop starving behind scans)", _pos)
+DEF("admission_large_slots", 2, "int",
+    "concurrent statements of the low-priority large-query lane", _pos)
+
+# overload robustness: memstore write backpressure
+DEF("memstore_limit_bytes", 256 << 20, "cap",
+    "per-tenant unflushed memstore byte budget; writes at the limit "
+    "raise typed MemstoreFull until the freeze/flush catches up", _pos)
+DEF("writing_throttle_trigger_pct", 60, "int",
+    "percentage of memstore_limit_bytes past which writers pay a "
+    "ramped sleep before each append (≙ "
+    "writing_throttling_trigger_percentage)",
+    lambda v: 1 <= v <= 100)
+DEF("writing_throttle_max_sleep_s", 0.05, "float",
+    "per-write sleep ceiling of the memstore throttle ramp", _pos)
 
 # PX / distributed
 DEF("px_default_dop", 0, "int",
@@ -158,8 +203,13 @@ DEF("health_down_threshold", 4, "int",
     "triggers immediate re-election instead of lease expiry)", _pos)
 DEF("rpc_conn_pool_size", 4, "int",
     "idle connections kept per RpcClient; calls beyond it dial extra "
-    "sockets so control-plane pings never queue behind bulk transfers",
-    _pos)
+    "sockets so control-plane pings never queue behind bulk transfers "
+    "(LRU extras close on checkin)", _pos)
+DEF("rpc_max_conns_per_peer", 16, "int",
+    "hard cap on live sockets (idle + in-flight) per RpcClient; "
+    "checkout past it waits for a checkin inside the call deadline and "
+    "then fails with typed ConnPoolExhausted instead of growing "
+    "without bound under fan-out load", _pos)
 
 # storage
 DEF("memstore_limit_rows", 1_000_000, "int",
@@ -213,8 +263,12 @@ DEF("enable_disk_faults", False, "bool",
 DEF("tenant_cpu_quota", 4, "int", "worker threads per tenant unit", _pos)
 DEF("tenant_memory_limit", 4 << 30, "cap",
     "per-tenant memory budget in bytes", _pos)
-DEF("enable_rate_limit", False, "bool",
-    "throttle writes on memstore pressure (≙ write throttling)")
+DEF("enable_rate_limit", True, "bool",
+    "memstore write backpressure (server/admission.py::"
+    "MemstoreThrottle): account unflushed bytes per write, ramp writer "
+    "sleeps past writing_throttle_trigger_pct of "
+    "memstore_limit_bytes, raise MemstoreFull at the hard limit "
+    "(≙ write throttling)")
 
 # diagnostics
 DEF("enable_metrics", True, "bool",

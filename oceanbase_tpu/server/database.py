@@ -74,6 +74,13 @@ class Database:
         # per-query spill records (feeds v$sql_workarea,
         # ≙ the SQL memory manager's work-area profiles)
         self.workarea_history: list[dict] = []
+        # overload plane: statement admission + fair queuing + KILL
+        # (server/admission.py); per-tenant WRR weights read live from
+        # each tenant's config overlay
+        from oceanbase_tpu.server.admission import AdmissionController
+
+        self.admission = AdmissionController(
+            self.config, weight_of=self._tenant_weight)
         self.virtual_tables = VirtualTables(self)
         if start_ash and self.config["enable_ash"]:
             self.ash.start()
@@ -114,6 +121,11 @@ class Database:
                             os.path.isdir(os.path.join(tdir, name)):
                         self.create_tenant(name, wal_replicas=wal_replicas,
                                            _boot=True)
+
+    def _tenant_weight(self, name: str) -> int:
+        t = self.tenants.get(name)
+        cfg = t.config if t is not None else self.config
+        return int(cfg["admission_tenant_weight"])
 
     # ------------------------------------------------------------------
     def create_tenant(self, name: str, wal_replicas: int = 3,

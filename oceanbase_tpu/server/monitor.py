@@ -45,6 +45,7 @@ class AuditRecord:
     error: str = ""
     compile_s: float = 0.0
     trace_id: str = ""         # joins gv$trace / SHOW TRACE
+    queue_s: float = 0.0       # admission queue wait (overload plane)
 
 
 class SqlAudit:

@@ -157,6 +157,30 @@ class Tenant:
             int(self.config["px_workers_per_tenant"]))
         self.memory_used = 0
 
+        # memstore write backpressure (≙ writing throttling): byte
+        # accounting + ramp/hard-limit at the TransService.write choke
+        # point; pressure kicks a horizon-clamped freeze/flush of the
+        # fattest table, and the engine's flush listener re-bases the
+        # accounting when any flush (throttle-kicked, row-threshold or
+        # checkpoint) clears memtable rows
+        from oceanbase_tpu.server.admission import MemstoreThrottle
+
+        self.throttle = MemstoreThrottle(self.config,
+                                         flush_cb=self._pressure_flush)
+        self.tx.throttle = self.throttle
+        self.engine.flush_listener = self.throttle.on_flush
+
+    def _pressure_flush(self, table: str):
+        """Memstore-pressure flush: freeze + flush ``table`` at the
+        PR-6 flush horizon (never past a live writer's snapshot) so
+        throttled writers unblock without losing conflict checks."""
+        try:
+            self.engine.freeze_and_flush(
+                table, snapshot=self.tx.flush_snapshot())
+            self.catalog.invalidate(table)
+        except KeyError:
+            self.throttle.drop_table(table)  # dropped mid-pressure
+
     def kv(self, table: str):
         """OBKV-style table API handle (≙ src/libtable client)."""
         from oceanbase_tpu.kv import KvTable

@@ -47,6 +47,7 @@ class VirtualTables:
             "gv$sysstat": self.sysstat,
             "gv$sysstat_histogram": self.sysstat_histogram,
             "gv$memory": self.memory,
+            "gv$tenant_resource": self.tenant_resource,
             "v$session_history": self.session_history,
             "v$parameters": self.parameters,
             "v$tenants": self.tenants,
@@ -78,6 +79,83 @@ class VirtualTables:
             "rows_returned": np.array([r.rows for r in recs], np.int64),
             "error": _obj(r.error for r in recs),
             "trace_id": _obj(r.trace_id for r in recs),
+            # admission queue wait (overload plane): how long the
+            # statement sat QUEUED before its slot was granted
+            "queue_s": np.array([getattr(r, "queue_s", 0.0)
+                                 for r in recs], np.float64),
+        }
+
+    def tenant_resource(self):
+        """Overload-plane snapshot per tenant (≙ gv$ob_units /
+        __all_virtual_tenant_resource): admission slots + queue depth,
+        the large-query lane, and memstore backpressure state."""
+        adm = getattr(self.db, "admission", None)
+        rows = adm.stats() if adm is not None else []
+        by_tenant = {r["tenant"]: r for r in rows}
+        tenants = getattr(self.db, "tenants", {}) or {}
+        # tenants that exist but have not run a statement yet still
+        # get a row (their throttle state matters before first query)
+        for name in tenants:
+            by_tenant.setdefault(name, {"tenant": name})
+        out = []
+        for name in sorted(by_tenant):
+            r = dict(by_tenant[name])
+            thr = getattr(tenants.get(name), "throttle", None)
+            ts = thr.stats() if thr is not None else {}
+            out.append({
+                "tenant": name,
+                "slots_in_use": r.get("slots_in_use", 0),
+                "slots_total": r.get("slots_total", 0),
+                "queue_depth": r.get("queue_depth", 0),
+                "queue_limit": r.get("queue_limit", 0),
+                "weight": r.get("weight", 1),
+                "admitted": r.get("admitted", 0),
+                "queued": r.get("queued", 0),
+                "rejected": r.get("rejected", 0),
+                "kills": r.get("kills", 0),
+                "timeouts": r.get("timeouts", 0),
+                "large_in_use": r.get("large_in_use", 0),
+                "large_slots": r.get("large_slots", 0),
+                "memstore_bytes": ts.get("memstore_bytes", 0),
+                "memstore_limit_bytes":
+                    ts.get("memstore_limit_bytes", 0),
+                "throttle_state": ts.get("throttle_state", "off"),
+                "throttle_sleeps": ts.get("throttle_sleeps", 0),
+                "memstore_full_rejections":
+                    ts.get("memstore_full_rejections", 0),
+            })
+        return {
+            "tenant": _obj(r["tenant"] for r in out),
+            "slots_in_use": np.array([r["slots_in_use"] for r in out],
+                                     np.int64),
+            "slots_total": np.array([r["slots_total"] for r in out],
+                                    np.int64),
+            "queue_depth": np.array([r["queue_depth"] for r in out],
+                                    np.int64),
+            "queue_limit": np.array([r["queue_limit"] for r in out],
+                                    np.int64),
+            "weight": np.array([r["weight"] for r in out], np.int64),
+            "admitted": np.array([r["admitted"] for r in out],
+                                 np.int64),
+            "queued": np.array([r["queued"] for r in out], np.int64),
+            "rejected": np.array([r["rejected"] for r in out],
+                                 np.int64),
+            "kills": np.array([r["kills"] for r in out], np.int64),
+            "timeouts": np.array([r["timeouts"] for r in out],
+                                 np.int64),
+            "large_in_use": np.array([r["large_in_use"] for r in out],
+                                     np.int64),
+            "large_slots": np.array([r["large_slots"] for r in out],
+                                    np.int64),
+            "memstore_bytes": np.array(
+                [r["memstore_bytes"] for r in out], np.int64),
+            "memstore_limit_bytes": np.array(
+                [r["memstore_limit_bytes"] for r in out], np.int64),
+            "throttle_state": _obj(r["throttle_state"] for r in out),
+            "throttle_sleeps": np.array(
+                [r["throttle_sleeps"] for r in out], np.int64),
+            "memstore_full_rejections": np.array(
+                [r["memstore_full_rejections"] for r in out], np.int64),
         }
 
     def trace(self):

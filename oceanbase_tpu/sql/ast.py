@@ -238,6 +238,15 @@ class AnalyzeStmt:
 
 
 @dataclass
+class KillStmt:
+    """KILL [QUERY] <session_id> — cancel the target session's running
+    (or queued) statement; plain KILL also flags the whole session."""
+
+    kind: str        # "query" | "session"
+    session_id: int
+
+
+@dataclass
 class SetVarStmt:
     scope: str   # session | global
     name: str

@@ -197,6 +197,17 @@ class Parser:
             self.next()
             self.expect_kw("tables")
             return ast.LockTableStmt(unlock=True)
+        if self.peek().kind == "ident" and self.peek().value == "kill":
+            # KILL [QUERY] <session_id> (MySQL-flavored: both forms
+            # take a session id; QUERY cancels only the running
+            # statement, plain KILL flags the session too)
+            self.next()
+            kind = "query" if self._accept_word("query") else "session"
+            t = self.next()
+            if t.kind != "number":
+                raise ParseError(
+                    f"expected a session id after KILL at {t.pos}")
+            return ast.KillStmt(kind, int(t.value))
         if self.at_kw("set"):
             return self.parse_set()
         if self.at_kw("alter"):

@@ -119,12 +119,17 @@ class Session:
                 db.ash.register(self.session_id, self._ash_state)
 
     def close(self):
-        """Release session resources (ASH slot, open transaction)."""
+        """Release session resources (ASH slot, open transaction,
+        admission eviction flag)."""
         if self._tx is not None and self.db is not None:
             self._txsvc.rollback(self._tx)
             self._tx = None
         if self.db is not None and getattr(self.db, "ash", None) is not None:
             self.db.ash.unregister(self.session_id)
+        adm = (getattr(self.db, "admission", None)
+               if self.db is not None else None)
+        if adm is not None:
+            adm.forget_session(self.session_id)
 
     # tenant-scoped module stack (falls back to the db's sys tenant)
     @property
@@ -140,10 +145,47 @@ class Session:
         return self.db.engine
 
     # ------------------------------------------------------------------
+    # statement shapes that pay admission (queries + DML + anything
+    # that executes a plan); admin/control statements — SET, SHOW,
+    # KILL, ALTER SYSTEM, transaction verbs — bypass so the operator
+    # can still steer a saturated server
+    _ADMITTED_STMTS = (ast.SelectStmt, ast.InsertStmt, ast.UpdateStmt,
+                       ast.DeleteStmt, ast.CallStmt, ast.LoadDataStmt)
+
+    def _needs_admission(self, stmt) -> bool:
+        if isinstance(stmt, self._ADMITTED_STMTS):
+            return True
+        if isinstance(stmt, ast.ExplainStmt) and \
+                getattr(stmt, "analyze", False):
+            return True  # EXPLAIN ANALYZE executes the plan
+        if isinstance(stmt, ast.CreateTableStmt) and \
+                getattr(stmt, "as_select", None) is not None:
+            return True  # CTAS executes its SELECT
+        return False
+
+    def _stmt_timeout_s(self) -> float | None:
+        """Effective per-statement deadline: the session variable wins
+        (SET query_timeout_s = 0.5 works sub-second), else the config
+        default."""
+        v = self.variables.get("query_timeout_s")
+        if v is None and self.db is not None:
+            v = self.db.config["query_timeout_s"]
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            return None
+        return v if v > 0 else None
+
     def execute(self, sql: str, params: list | None = None) -> Result:
         """Parse + execute one statement, with request auditing, ASH
         state, and a full-link trace root span (≙ obmp_query process +
-        sql_audit recording + ObTrace begin/end)."""
+        sql_audit recording + ObTrace begin/end).
+
+        Overload plane: query/DML statements check a per-tenant
+        admission slot out BEFORE binding (typed ServerBusy when the
+        bounded queue is full) and run under a StmtCtx whose deadline
+        and KILL flag the result-boundary checkpoints observe."""
+        from oceanbase_tpu.server import admission as qadmission
         from oceanbase_tpu.server import trace as qtrace
 
         start = time.time()        # wall ts for the audit record
@@ -156,18 +198,51 @@ class Session:
             trace_id=tctx.trace_id if tctx is not None else "")
         self._last_compile_s = 0.0
         self._stmt_is_show_trace = False  # set by _show_trace()
+        admission = (getattr(self.db, "admission", None)
+                     if self.db is not None else None)
+        ctx: qadmission.StmtCtx | None = None
         try:
+            if admission is not None:
+                # a session evicted by plain KILL <id> takes no more
+                # statements (typed; the client reconnects)
+                admission.check_session(self.session_id)
             with qtrace.activate(tctx):
                 with qtrace.span("statement", sql=sql[:200],
                                  session=self.session_id):
                     stmt = parse_sql(sql)
-                    self._materialize_virtuals(stmt)
-                    out = self.execute_stmt(stmt, params)
-                    return out
+                    if admission is not None and \
+                            self._needs_admission(stmt):
+                        ctx = qadmission.StmtCtx(
+                            session_id=self.session_id,
+                            tenant=getattr(self.tenant, "name", "sys"),
+                            sql=sql,
+                            timeout_s=self._stmt_timeout_s(),
+                            controller=admission,
+                            ash_state=self._ash_state)
+                        self._ash_state["state"] = "queued"
+                        try:
+                            admission.acquire(ctx)
+                        finally:
+                            if self._ash_state.get("state") == "queued":
+                                self._ash_state["state"] = "executing"
+                        if ctx.queue_s > 0:
+                            # queued time is a first-class wait: a span
+                            # in the statement tree + gv$sql_audit's
+                            # queue_s column (emitted only when the
+                            # statement actually waited)
+                            qtrace.add_span("admission.wait",
+                                            ctx.queue_s,
+                                            tenant=ctx.tenant)
+                    with qadmission.activate(ctx):
+                        self._materialize_virtuals(stmt)
+                        out = self.execute_stmt(stmt, params)
+                        return out
         except Exception as e:
             err = f"{type(e).__name__}: {e}"
             raise
         finally:
+            if ctx is not None:
+                admission.release(ctx)
             elapsed = time.monotonic() - t0
             self._ash_state.update(active=False, state="idle",
                                    trace_id="")
@@ -204,6 +279,7 @@ class Session:
                     error=err,
                     compile_s=self._last_compile_s,
                     trace_id=trace_id,
+                    queue_s=ctx.queue_s if ctx is not None else 0.0,
                 ))
 
     def _materialize_virtuals(self, stmt):
@@ -345,6 +421,8 @@ class Session:
                 {}, {}, rowcount=len(td.columns))
         if isinstance(stmt, ast.AnalyzeStmt):
             return self._analyze(stmt)
+        if isinstance(stmt, ast.KillStmt):
+            return self._kill(stmt)
         if isinstance(stmt, ast.TxStmt):
             return self._tx_control(stmt.op)
         if isinstance(stmt, ast.SavepointStmt):
@@ -479,11 +557,17 @@ class Session:
             if stmt.what == "metrics":
                 return self._show_metrics()
             if stmt.what == "processlist":
+                # admission-plane states surface MySQL-style: QUEUED
+                # (waiting for a slot), RUNNING, KILLED (flagged, still
+                # unwinding), IDLE
+                disp = {"executing": "RUNNING", "queued": "QUEUED",
+                        "killed": "KILLED", "idle": "IDLE"}
                 rows = []
                 if self.db is not None and \
                         getattr(self.db, "ash", None) is not None:
                     for sid, st in self.db.ash.sessions().items():
-                        rows.append((sid, st.get("state", "idle"),
+                        raw = st.get("state", "idle")
+                        rows.append((sid, disp.get(raw, raw.upper()),
                                      st.get("sql", "")[:120]))
                 rows.sort()
                 return Result(
@@ -514,6 +598,30 @@ class Session:
                                    dtype=object)},
                 {}, {}, rowcount=len(snap))
         raise NotImplementedError(type(stmt).__name__)
+
+    def _kill(self, stmt: ast.KillStmt) -> Result:
+        """KILL [QUERY] <session_id>: flag the target's running (or
+        queued) statement; the victim unwinds with typed QueryKilled at
+        its next host-side checkpoint (operator close / spill chunk /
+        DTL slice join / retry ladder) — and in-flight remote DTL
+        fragments are cancelled over the idempotent dtl.cancel verb."""
+        adm = (getattr(self.db, "admission", None)
+               if self.db is not None else None)
+        if adm is None:
+            raise NotImplementedError("KILL needs a Database")
+        # existence first (MySQL: ER_NO_SUCH_THREAD): plain KILL must
+        # not plant eviction flags for ids that were never sessions
+        ash = getattr(self.db, "ash", None)
+        known = (ash is not None
+                 and stmt.session_id in ash.sessions()) \
+            or stmt.session_id == self.session_id
+        if not known:
+            raise KeyError(f"unknown session id {stmt.session_id}")
+        # KILL QUERY cancels the in-flight statement (rowcount 0 on an
+        # idle session); plain KILL also evicts the session itself
+        found = adm.kill(stmt.session_id,
+                         query_only=(stmt.kind == "query"))
+        return _ok(rowcount=1 if found else 0)
 
     def _set_var(self, stmt: ast.SetVarStmt) -> Result:
         if stmt.scope == "global":
@@ -1094,14 +1202,20 @@ class Session:
         t0 = time.monotonic()  # plan-monitor total_s (step-proof delta)
         self._last_px = False  # did the last query run through PX?
         self._last_dtl = False  # did it push down over the DTL exchange?
+        self._last_px_downgrade = False  # px admission denied -> serial
         # cross-node compute pushdown (px/dtl.py): ship the partial plan
         # to the cluster's data nodes instead of scanning everything on
         # this node; an open transaction keeps the own-writes read path
         dtl = (getattr(self.db, "dtl", None)
                if self.db is not None and self._tx is None else None)
+        from oceanbase_tpu.server import admission as qadmission
+
         with qtrace.span("execute") as xsp:
             for attempt in range(
                     int(self.variables["max_capacity_retry"]) + 1):
+                # retry-ladder checkpoint: a killed/expired statement
+                # must not re-plan and re-execute with bigger budgets
+                qadmission.checkpoint()
                 try:
                     p = plan if factor == 1 \
                         else scale_capacities(plan, factor)
@@ -1156,6 +1270,10 @@ class Session:
             xsp.tags.update(attempts=attempt + 1, factor=factor,
                             dtl=int(self._last_dtl),
                             px=int(self._last_px))
+            if self._last_px_downgrade:
+                # the satellite: a px_admission denial is visible on
+                # the statement's trace span, not silently serial
+                xsp.tags["px_downgrade"] = 1
         if factor > 1 and use_cache:
             # evolve the cached plan: a plan bound against a smaller
             # table keeps overflowing its stale capacity budgets, which
@@ -1422,7 +1540,13 @@ class Session:
 
         if self.tenant is not None:
             if not self.tenant.px_admission.acquire(blocking=False):
-                return None  # admission denied: run serial (≙ px downgrade)
+                # admission denied: run serial (≙ px downgrade) — but
+                # VISIBLY: counted, span-tagged, shown by EXPLAIN
+                # ANALYZE (the silent downgrade was unobservable)
+                qmetrics.inc("admission.px_downgrades",
+                             tenant=getattr(self.tenant, "name", "sys"))
+                self._last_px_downgrade = True
+                return None
         try:
             rel = execute_plan_distributed(plan, tables, dop=dop,
                                            budget_factor=factor)
@@ -1763,6 +1887,16 @@ class Session:
                         logical_hash=_lh(plan), retries=attempt,
                         path="serial")
         text = format_plan(plan, row_counts=row_counts) + spill_line
+        if analyze and self.tenant is not None and self._px_dop() > 1:
+            # surface the px_admission verdict the statement would get
+            # RIGHT NOW: a denied probe means concurrent PX statements
+            # hold the tenant quota and this plan runs serial
+            if self.tenant.px_admission.acquire(blocking=False):
+                self.tenant.px_admission.release()
+            else:
+                text += ("\npx: admission denied "
+                         f"(dop={self._px_dop()} downgraded to serial; "
+                         "see admission.px_downgrades)")
         if row_counts:
             worst = max(row_counts.values(),
                         key=lambda r: r.get("q_error", 0.0))

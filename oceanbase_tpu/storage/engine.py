@@ -137,6 +137,11 @@ class StorageEngine:
         # consulted AFTER every persistence write so seeded bitflip/
         # truncate rules can target artifacts by kind
         self.faults = None
+        # flush listener (tenant wiring): called AFTER freeze_and_flush
+        # with (table, rows still resident in the memtables) so the
+        # memstore write-backpressure accounting re-bases when a flush
+        # clears pressure (server/admission.py::MemstoreThrottle)
+        self.flush_listener = None
         self.meta: dict = {}  # checkpointed runtime meta (wal replay point…)
         # table -> WAL LSN of the newest TRUNCATE whose slog record this
         # engine has already applied; WAL replay must not re-apply
@@ -854,7 +859,15 @@ class StorageEngine:
                     self._log_meta({"op": "add_segment", "table": name,
                                     "segment_id": seg.segment_id,
                                     "part": part})
-            return segs[0][1] if segs else None
+            tab = ts.tablet
+            remaining = sum(
+                len(t.active) + sum(len(f) for f in t.frozen)
+                for t in getattr(tab, "partitions", None) or [tab])
+        listener = self.flush_listener
+        if listener is not None:
+            # outside the engine lock: the throttle takes its own lock
+            listener(name, remaining)
+        return segs[0][1] if segs else None
 
     def _compact(self, name: str, level_filter, method: str):
         with self._lock:

@@ -102,6 +102,12 @@ class TransService:
         self.wal = wal            # PalfCluster or None (no replication)
         self.lock_table = None    # tx/tablelock.LockTable when attached
         self.lock_wait_timeout_s = 5.0
+        # memstore write backpressure (server/admission.py::
+        # MemstoreThrottle, wired by the tenant): write() is the one
+        # choke point every writer crosses — session DML, PDML workers,
+        # OBKV — so accounting and the ramp/hard-limit gate live here;
+        # None disables (bare unit use, WAL replay writes bypass write())
+        self.throttle = None
         # StorageEngine for secondary-index maintenance (set by the
         # tenant wiring); None disables maintenance (e.g. bare unit use)
         self.engine = None
@@ -171,6 +177,11 @@ class TransService:
               op: str, values: dict):
         if tx.state != TxState.ACTIVE:
             raise TxAborted(f"tx {tx.tx_id} is {tx.state.value}")
+        if self.throttle is not None and not table.startswith("__idx__"):
+            # BEFORE the append: ramped sleep past the trigger, typed
+            # MemstoreFull at the hard limit (index maintenance rides
+            # its base write's admission — accounting would double)
+            self.throttle.admit_write(table, values)
         if self.lock_table is not None:
             # implicit intent-exclusive table lock: honors LOCK TABLES
             # READ/WRITE held by other transactions (released at tx end)

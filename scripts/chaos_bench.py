@@ -613,6 +613,86 @@ def main():
             "oracle_match": served2 == oracle,
             "quarantine_events": len(quar)}
 
+        # ---- scenario 7: overload shed — 4x load + a node kill -----
+        # a statement storm far over the admission slots while a data
+        # node dies mid-storm: zero hangs, failures are TYPED shed/
+        # routing errors only, and every ADMITTED (successful) result
+        # is bit-identical to the independent sqlite oracle
+        for knob, val in (("admission_slots", 2),
+                          ("admission_tenant_slots", 2),
+                          ("admission_queue_limit", 2),
+                          ("admission_queue_timeout_s", 1.0)):
+            sql(f"alter system set {knob} = {val}")
+        shed_ok_kinds = {
+            # admission/deadline shed (the overload plane's contract)
+            "ServerBusy", "QueryTimeout", "QueryKilled",
+            "MemstoreFull",
+            # routing/network faults of the concurrent node kill —
+            # typed at the rpc/palf layer, retried by real clients
+            "NotLeader", "NoQuorum", "DeadlineExceeded",
+            "ConnPoolExhausted", "DtlLagging", "ConnectionError",
+            "ConnectionResetError", "BrokenPipeError", "TimeoutError"}
+        storm_results: list = []
+        storm_lock = threading.Lock()
+
+        def storm_client(k):
+            # one DISTINCT server-side session per client (sessions are
+            # single-statement state machines, like real wire clients)
+            name = "q6" if k % 2 == 0 else "q1"
+            for _ in range(3):
+                t0 = time.monotonic()
+                kind, rows = "ok", None
+                try:
+                    rows = rows_of(clients[1].call(
+                        "sql.execute", sql=QUERIES[name],
+                        session_id=1000 + k))
+                except Exception as e:  # noqa: BLE001 — triaged
+                    kind = getattr(e, "kind", type(e).__name__)
+                dt = time.monotonic() - t0
+                with storm_lock:
+                    storm_results.append((name, kind, rows, dt))
+
+        storm_threads = [threading.Thread(target=storm_client,
+                                          args=(k,))
+                         for k in range(12)]
+        for t in storm_threads:
+            t.start()
+        time.sleep(0.3)  # the storm is in flight: kill a data node
+        procs[3].send_signal(signal.SIGKILL)
+        procs[3].wait(timeout=10)
+        for t in storm_threads:
+            t.join(QUERY_DEADLINE_S * 2)
+        shed_hung = sum(1 for _n, _k, _r, dt in storm_results
+                        if dt > QUERY_DEADLINE_S) + \
+            sum(1 for t in storm_threads if t.is_alive())
+        shed_kinds: dict = {}
+        for _n, k, _r, _dt in storm_results:
+            shed_kinds[k] = shed_kinds.get(k, 0) + 1
+        untyped = {k: v for k, v in shed_kinds.items()
+                   if k != "ok" and k not in shed_ok_kinds}
+        mismatches = [(n, _round_rows(r))
+                      for n, k, r, _dt in storm_results
+                      if k == "ok" and _round_rows(r) != oracle[n]]
+        admitted_parity = not mismatches
+        admitted = shed_kinds.get("ok", 0)
+        for knob, val in (("admission_slots", 32),
+                          ("admission_tenant_slots", 16),
+                          ("admission_queue_limit", 64),
+                          ("admission_queue_timeout_s", 10.0)):
+            sql(f"alter system set {knob} = {val}")
+        tr = rows_of(sql("select tenant, admitted, rejected, queued "
+                         "from gv$tenant_resource"))
+        out["scenarios"]["overload_shed"] = {
+            "parity": bool(admitted_parity and shed_hung == 0
+                           and not untyped and admitted > 0),
+            "p99_s": round(p99([dt for *_x, dt in storm_results]), 3),
+            "queries": len(storm_results), "hung": shed_hung,
+            "admitted": admitted, "kinds": shed_kinds,
+            "untyped_errors": untyped,
+            "admitted_oracle_parity": admitted_parity,
+            "parity_mismatches": len(mismatches),
+            "tenant_resource": [list(r) for r in tr]}
+
         out["parity_all"] = all(s["parity"]
                                 for s in out["scenarios"].values())
         out["hung_total"] = sum(s["hung"]

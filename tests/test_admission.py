@@ -1,0 +1,858 @@
+"""Overload-robustness plane: statement admission + fair queuing,
+deadlines & KILL, write backpressure, dtl.cancel, bounded rpc pool
+(server/admission.py, net/rpc.py, px/dtl.py).
+
+≙ the resource-manager / large-query-queue / writing-throttling mittest
+suites.  Everything here is in-process and fast (tier-1); the 3-node
+overload_shed storm lives in scripts/chaos_bench.py and the offered-load
+gate in scripts/overload_bench.py.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.server.admission import (
+    AdmissionController,
+    MemstoreFull,
+    MemstoreThrottle,
+    QueryKilled,
+    QueryTimeout,
+    RemoteCtx,
+    ServerBusy,
+    StmtCtx,
+    activate,
+    checkpoint,
+)
+from oceanbase_tpu.server.config import Config
+from oceanbase_tpu.server.database import Database
+
+
+# ---------------------------------------------------------------------------
+# controller unit tests (no database)
+# ---------------------------------------------------------------------------
+
+
+def _cfg(**over):
+    c = Config()
+    for k, v in over.items():
+        c.set(k, v)
+    return c
+
+
+def _ctx(sid=1, tenant="sys", timeout_s=None, controller=None):
+    return StmtCtx(session_id=sid, tenant=tenant, timeout_s=timeout_s,
+                   controller=controller)
+
+
+def test_slot_checkout_release_and_stats():
+    adm = AdmissionController(_cfg(admission_slots=2,
+                                   admission_tenant_slots=2))
+    a, b = _ctx(1), _ctx(2)
+    adm.acquire(a)
+    adm.acquire(b)
+    assert adm.active_slots() == 2
+    adm.release(a)
+    adm.release(b)
+    assert adm.active_slots() == 0
+    row = adm.stats()[0]
+    assert row["tenant"] == "sys" and row["admitted"] == 2
+
+
+def test_full_queue_rejects_serverbusy_fast():
+    adm = AdmissionController(_cfg(admission_slots=1,
+                                   admission_tenant_slots=1,
+                                   admission_queue_limit=0))
+    adm.acquire(_ctx(1))
+    t0 = time.monotonic()
+    with pytest.raises(ServerBusy):
+        adm.acquire(_ctx(2))
+    assert time.monotonic() - t0 < 1.0  # rejected fast, no wait
+    assert adm.stats()[0]["rejected"] == 1
+
+
+def test_queue_wait_budget_rejects_typed():
+    adm = AdmissionController(_cfg(admission_slots=1,
+                                   admission_tenant_slots=1,
+                                   admission_queue_limit=4,
+                                   admission_queue_timeout_s=0.15))
+    adm.acquire(_ctx(1))
+    t0 = time.monotonic()
+    with pytest.raises(ServerBusy):
+        adm.acquire(_ctx(2))
+    dt = time.monotonic() - t0
+    assert 0.1 <= dt < 2.0  # waited the budget, then failed typed
+
+
+def test_queued_statement_grants_on_release():
+    adm = AdmissionController(_cfg(admission_slots=1,
+                                   admission_tenant_slots=1))
+    a = _ctx(1)
+    adm.acquire(a)
+    got = []
+
+    def waiter():
+        c = _ctx(2)
+        adm.acquire(c)
+        got.append(c)
+        adm.release(c)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.1)
+    assert not got  # still queued
+    adm.release(a)
+    th.join(5)
+    assert got and got[0].queue_s > 0
+
+
+def test_wrr_fairness_across_tenants():
+    """One slot, a loud tenant with 8 waiters vs a quiet one with 2:
+    round-robin interleaves grants — the quiet tenant's statements do
+    not sit behind the loud tenant's whole backlog."""
+    adm = AdmissionController(_cfg(admission_slots=1,
+                                   admission_tenant_slots=1,
+                                   admission_queue_limit=16,
+                                   admission_queue_timeout_s=30.0))
+    hold = _ctx(0)
+    adm.acquire(hold)
+    order: list[str] = []
+    lock = threading.Lock()
+    threads = []
+
+    def waiter(sid, tenant):
+        c = StmtCtx(session_id=sid, tenant=tenant)
+        adm.acquire(c)
+        with lock:
+            order.append(tenant)
+        time.sleep(0.01)
+        adm.release(c)
+
+    for i in range(8):
+        threads.append(threading.Thread(target=waiter,
+                                        args=(10 + i, "loud")))
+    for i in range(2):
+        threads.append(threading.Thread(target=waiter,
+                                        args=(50 + i, "quiet")))
+    for t in threads:
+        t.start()
+    time.sleep(0.2)  # everyone queued behind `hold`
+    adm.release(hold)
+    for t in threads:
+        t.join(20)
+    assert len(order) == 10
+    # both quiet statements admitted within the first half of grants:
+    # WRR alternates tenants instead of draining `loud` first
+    assert all(t in order[:6] for t in ["quiet"]) and \
+        order[:6].count("quiet") == 2, order
+
+
+def test_wrr_weight_biases_grants():
+    cfg = _cfg(admission_slots=1, admission_tenant_slots=1,
+               admission_queue_limit=16,
+               admission_queue_timeout_s=30.0)
+    weights = {"heavy": 2, "light": 1}
+    adm = AdmissionController(cfg, weight_of=lambda t: weights.get(t, 1))
+    hold = _ctx(0)
+    adm.acquire(hold)
+    order = []
+    lock = threading.Lock()
+
+    def waiter(sid, tenant):
+        c = StmtCtx(session_id=sid, tenant=tenant)
+        adm.acquire(c)
+        with lock:
+            order.append(tenant)
+        adm.release(c)
+
+    threads = [threading.Thread(target=waiter, args=(10 + i, "heavy"))
+               for i in range(4)]
+    threads += [threading.Thread(target=waiter, args=(50 + i, "light"))
+                for i in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    adm.release(hold)
+    for t in threads:
+        t.join(20)
+    # weight 2:1 -> heavy gets ~2 grants per light one in the prefix
+    assert order.count("heavy") == 4 and order.count("light") == 4
+    assert order[:3].count("heavy") >= 2
+
+
+def test_kill_while_queued_raises_querykilled():
+    adm = AdmissionController(_cfg(admission_slots=1,
+                                   admission_tenant_slots=1,
+                                   admission_queue_timeout_s=30.0))
+    adm.acquire(_ctx(1))
+    victim = _ctx(2)
+    err = []
+
+    def waiter():
+        try:
+            adm.acquire(victim)
+        except BaseException as e:  # noqa: BLE001 — captured for assert
+            err.append(e)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.1)
+    victim.kill()
+    th.join(5)
+    assert err and isinstance(err[0], QueryKilled)
+
+
+def test_checkpoint_timeout_and_kill():
+    ctx = _ctx(timeout_s=0.05)
+    with activate(ctx):
+        checkpoint()  # inside the deadline: fine
+        time.sleep(0.08)
+        with pytest.raises(QueryTimeout):
+            checkpoint()
+    ctx2 = _ctx()
+    with activate(ctx2):
+        ctx2.kill()
+        with pytest.raises(QueryKilled):
+            checkpoint()
+    checkpoint()  # no active ctx: no-op
+
+
+def test_large_query_demotion_frees_slot():
+    adm = AdmissionController(_cfg(admission_slots=1,
+                                   admission_tenant_slots=1,
+                                   large_query_threshold_s=0.01,
+                                   admission_large_slots=2,
+                                   admission_queue_timeout_s=30.0))
+    big = _ctx(1, controller=adm)
+    adm.acquire(big)
+    got = []
+
+    def pointq():
+        c = _ctx(2)
+        adm.acquire(c)
+        got.append(c)
+        adm.release(c)
+
+    th = threading.Thread(target=pointq)
+    th.start()
+    time.sleep(0.1)
+    assert not got  # the scan holds the only slot
+    with activate(big):
+        checkpoint()  # past the threshold: demotes to the large lane
+    th.join(5)
+    assert got, "demotion must free the normal slot for the point query"
+    assert big.lane == "large" and big.demoted
+    adm.release(big)
+    assert adm.active_slots() == 0
+
+
+def test_release_after_rejection_does_not_over_admit():
+    """A rejected acquire holds nothing: the session's finally still
+    calls release(ctx), which must NOT decrement someone else's slot
+    (over-admitting by one per rejection under load)."""
+    adm = AdmissionController(_cfg(admission_slots=1,
+                                   admission_tenant_slots=1,
+                                   admission_queue_limit=0))
+    holder = _ctx(1)
+    adm.acquire(holder)
+    loser = _ctx(2)
+    with pytest.raises(ServerBusy):
+        adm.acquire(loser)
+    adm.release(loser)  # what Session.execute's finally does
+    assert adm.active_slots() == 1  # the holder's slot is intact
+    # and the pool is still saturated: a third statement rejects too
+    with pytest.raises(ServerBusy):
+        adm.acquire(_ctx(3))
+    adm.release(holder)
+    assert adm.active_slots() == 0
+
+
+def test_release_survives_knob_toggle_mid_statement():
+    """ctx.slot records what was taken; flipping enable_admission (or
+    slots to 0) mid-flight must neither leak nor double-free."""
+    cfg = _cfg(admission_slots=2, admission_tenant_slots=2)
+    adm = AdmissionController(cfg)
+    a = _ctx(1)
+    adm.acquire(a)
+    cfg.set("enable_admission", False)
+    adm.release(a)  # took a slot while enabled: must free it
+    cfg.set("enable_admission", True)
+    assert adm.active_slots() == 0
+    # and the other direction: admitted while DISABLED holds nothing
+    cfg.set("enable_admission", False)
+    b = _ctx(2)
+    adm.acquire(b)
+    cfg.set("enable_admission", True)
+    adm.release(b)
+    assert adm.active_slots() == 0
+
+
+def test_kill_reaches_queued_statement(db):
+    """KILL <id> of a statement still waiting in the admission FIFO
+    (state QUEUED) must cancel it — not silently no-op."""
+    db.config.set("admission_slots", 1)
+    db.config.set("admission_tenant_slots", 1)
+    db.config.set("admission_queue_timeout_s", 30.0)
+    hold = StmtCtx(session_id=998, tenant="sys")
+    db.admission.acquire(hold)
+    s, killer = db.session(), db.session()
+    res: dict = {}
+
+    def victim():
+        try:
+            res["r"] = s.execute("select 1")
+        except BaseException as e:  # noqa: BLE001 — captured
+            res["e"] = e
+
+    th = threading.Thread(target=victim)
+    th.start()
+    time.sleep(0.15)  # victim is parked in the FIFO now
+    assert killer.execute(f"kill {s.session_id}").rowcount == 1
+    th.join(10)
+    assert isinstance(res.get("e"), QueryKilled)
+    db.admission.release(hold)
+    assert db.admission.active_slots() == 0
+    db.config.set("admission_slots", 32)
+    db.config.set("admission_tenant_slots", 16)
+
+
+def test_demotion_denied_then_killed_frees_exactly_once():
+    """Kill while parked on a saturated large lane: the normal slot
+    was already yielded at demote time, so release() must not free a
+    second one."""
+    adm = AdmissionController(_cfg(admission_slots=2,
+                                   admission_tenant_slots=2,
+                                   admission_large_slots=1,
+                                   large_query_threshold_s=0.01))
+    occupier = _ctx(1, controller=adm)
+    adm.acquire(occupier)
+    with activate(occupier):
+        time.sleep(0.02)
+        checkpoint()  # takes the only large slot
+    assert occupier.lane == "large"
+    victim = _ctx(2, controller=adm)
+    adm.acquire(victim)
+    err = []
+
+    def run():
+        with activate(victim):
+            try:
+                time.sleep(0.02)
+                checkpoint()  # demotes; large lane full -> parks
+            except BaseException as e:  # noqa: BLE001 — captured
+                err.append(e)
+
+    th = threading.Thread(target=run)
+    th.start()
+    time.sleep(0.15)
+    victim.kill()
+    th.join(5)
+    assert err and isinstance(err[0], QueryKilled)
+    adm.release(victim)  # held NOTHING: must not free a second slot
+    assert adm.active_slots() == 1  # only the occupier's large slot
+    adm.release(occupier)
+    assert adm.active_slots() == 0
+
+
+def test_memstore_accepts_exactly_to_the_limit():
+    """An accepted write is never re-judged against its own bytes: a
+    write that fits exactly must succeed, and a rejected write must
+    not inflate the accounting."""
+    cfg = _cfg(enable_rate_limit=True, memstore_limit_bytes=1000,
+               writing_throttle_trigger_pct=99,
+               writing_throttle_max_sleep_s=0.001)
+    thr = MemstoreThrottle(cfg)
+    row = {"a": 1}  # 72 bytes under the estimate
+    nb = thr.row_bytes(row)
+    fits = 1000 // nb
+    for _ in range(fits):
+        thr.admit_write("t", row)  # every one fits: no spurious wall
+    used = thr.used_bytes()
+    assert used == fits * nb <= 1000
+    with pytest.raises(MemstoreFull):
+        thr.admit_write("t", row)
+    # the rejected row left no trace in the accounting
+    assert thr.used_bytes() == used
+
+
+def test_remote_ctx_observes_cancel_event():
+    ev = threading.Event()
+    with activate(RemoteCtx(ev, token="tok")):
+        checkpoint()
+        ev.set()
+        with pytest.raises(QueryKilled):
+            checkpoint()
+
+
+# ---------------------------------------------------------------------------
+# memstore throttle (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_memstore_hard_limit_typed_and_recovers():
+    cfg = _cfg(enable_rate_limit=True, memstore_limit_bytes=4096,
+               writing_throttle_trigger_pct=50,
+               writing_throttle_max_sleep_s=0.001)
+    flushed = []
+    thr = MemstoreThrottle(cfg, flush_cb=flushed.append)
+    row = {"a": 1, "b": "x" * 100}
+    with pytest.raises(MemstoreFull):
+        for _ in range(1000):
+            thr.admit_write("t", row)
+    st = thr.stats()
+    assert st["memstore_bytes"] <= 4096  # the limit held
+    # rejected writes never account, so used sits just UNDER the
+    # limit while the wall is up — deep in the throttle band
+    assert st["throttle_state"] in ("throttle", "full")
+    assert thr.throttle_sleeps > 0  # the ramp fired before the wall
+    assert flushed and flushed[0] == "t"  # pressure kicked a flush
+    # flush catches up: accounting re-bases, writes admit again
+    thr.on_flush("t", remaining_rows=0)
+    thr.admit_write("t", row)
+    assert thr.stats()["throttle_state"] in ("ok", "throttle")
+
+
+def test_memstore_accounting_rebase_keeps_avg():
+    cfg = _cfg(enable_rate_limit=True, memstore_limit_bytes=1 << 20)
+    thr = MemstoreThrottle(cfg)
+    for _ in range(10):
+        thr.admit_write("t", {"a": 1})
+    before = thr.used_bytes()
+    thr.on_flush("t", remaining_rows=5)
+    assert 0 < thr.used_bytes() < before
+
+
+# ---------------------------------------------------------------------------
+# SQL-level integration (Database + sessions)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(str(tmp_path / "db"))
+    yield d
+    d.close()
+
+
+def _load_big(s, n=20000):
+    s.execute("create table big (a int primary key, b int)")
+    vals = ", ".join(f"({i}, {i % 97})" for i in range(n))
+    s.execute(f"insert into big values {vals}")
+
+
+def test_query_timeout_typed_sql(db):
+    s = db.session()
+    _load_big(s)
+    db.config.set("sql_work_area_rows", 512)  # spill: many checkpoints
+    s.execute("set query_timeout_s = 0.05")
+    with pytest.raises(QueryTimeout):
+        s.execute("select sum(b), count(*) from big where b < 90")
+    # the deadline is per statement, not sticky damage: raise it back
+    s.execute("set query_timeout_s = 3600")
+    r = s.execute("select count(*) from big")
+    assert r.rows() == [(20000,)]
+
+
+def test_kill_query_mid_statement_and_hygiene(db):
+    """KILL unwinds a long (spilling) scan at a chunk checkpoint; no
+    dangling spill files, no leaked admission slots, no locked session,
+    and gv$sql_audit records the typed error."""
+    s = db.session()
+    _load_big(s)
+    db.config.set("sql_work_area_rows", 512)
+    killer = db.session()
+    res: dict = {}
+
+    def victim():
+        try:
+            res["r"] = s.execute(
+                "select sum(b), count(*) from big where b < 70")
+        except BaseException as e:  # noqa: BLE001 — captured
+            res["e"] = e
+
+    th = threading.Thread(target=victim)
+    th.start()
+    time.sleep(0.15)
+    killer.execute(f"kill query {s.session_id}")
+    th.join(15)
+    assert not th.is_alive(), "killed statement hung"
+    assert isinstance(res.get("e"), QueryKilled)
+    # hygiene: spill temp dirs removed, slots back to baseline,
+    # session usable, audit shows the typed error
+    tmpdir = os.path.join(db.root, "tmpfile")
+    leftovers = os.listdir(tmpdir) if os.path.isdir(tmpdir) else []
+    assert leftovers == []
+    assert db.admission.active_slots() == 0
+    assert s.execute("select 1").rows() == [(1,)]
+    errs = [r.error for r in db.audit.recent(None) if r.error]
+    assert any("QueryKilled" in e for e in errs)
+
+
+def test_kill_unknown_session_and_idle_session(db):
+    s = db.session()
+    with pytest.raises(KeyError):
+        s.execute("kill query 987654")
+    with pytest.raises(KeyError):
+        s.execute("kill 987654")  # plain KILL checks existence too
+    # KILL QUERY on an idle session: nothing in flight, 0 rows, the
+    # session stays usable
+    s2 = db.session()
+    assert s.execute(f"kill query {s2.session_id}").rowcount == 0
+    assert s2.execute("select 1").rows() == [(1,)]
+    # plain KILL EVICTS the session: later statements fail typed
+    assert s.execute(f"kill {s2.session_id}").rowcount == 1
+    with pytest.raises(QueryKilled):
+        s2.execute("select 1")
+    s2.close()
+    s3 = db.session()  # fresh session (reconnect): works
+    assert s3.execute("select 1").rows() == [(1,)]
+
+
+def test_memstore_flush_token_survives_unflushable_kick():
+    """A kick that cannot flush (oversized first write: nothing
+    accounted yet) must not wedge the one-shot token and disable
+    pressure flushes forever."""
+    cfg = _cfg(enable_rate_limit=True, memstore_limit_bytes=2048,
+               writing_throttle_trigger_pct=50,
+               writing_throttle_max_sleep_s=0.001)
+    flushed = []
+    thr = MemstoreThrottle(cfg, flush_cb=flushed.append)
+    with pytest.raises(MemstoreFull):
+        thr.admit_write("t", {"a": "x" * 4096})  # bigger than limit
+    assert not thr._flush_inflight
+    small = {"a": "y" * 400}
+    with pytest.raises(MemstoreFull):
+        for _ in range(100):
+            thr.admit_write("t", small)
+    assert flushed, "pressure flush never kicked after the bad first kick"
+
+
+def test_gv_tenant_resource_large_lane_is_per_tenant():
+    adm = AdmissionController(_cfg(admission_slots=4,
+                                   admission_tenant_slots=4,
+                                   admission_large_slots=2,
+                                   large_query_threshold_s=0.01))
+    a = StmtCtx(session_id=1, tenant="t1", controller=adm)
+    adm.acquire(a)
+    with activate(a):
+        time.sleep(0.02)
+        checkpoint()  # demotes into the large lane
+    rows = {r["tenant"]: r for r in adm.stats()}
+    assert rows["t1"]["large_in_use"] == 1
+    b = StmtCtx(session_id=2, tenant="t2", controller=adm)
+    adm.acquire(b)
+    rows = {r["tenant"]: r for r in adm.stats()}
+    assert rows["t2"]["large_in_use"] == 0  # not t1's demoted scan
+    adm.release(a)
+    adm.release(b)
+    rows = {r["tenant"]: r for r in adm.stats()}
+    assert rows["t1"]["large_in_use"] == 0
+
+
+def test_serverbusy_typed_under_saturation(db):
+    """admission_slots=1 + zero queue: a second concurrent statement
+    rejects typed while the first runs."""
+    db.config.set("admission_slots", 1)
+    db.config.set("admission_tenant_slots", 1)
+    db.config.set("admission_queue_limit", 0)
+    s1, s2 = db.session(), db.session()
+    _load_big(s1, n=4000)
+    db.config.set("sql_work_area_rows", 256)
+    errs: list = []
+    started = threading.Event()
+
+    def long_q():
+        started.set()
+        s1.execute("select sum(b), count(*) from big where b < 90")
+
+    def busy_q():
+        started.wait(5)
+        time.sleep(0.05)
+        try:
+            s2.execute("select count(*) from big")
+        except ServerBusy as e:
+            errs.append(e)
+
+    t1 = threading.Thread(target=long_q)
+    t2 = threading.Thread(target=busy_q)
+    t1.start()
+    t2.start()
+    t1.join(30)
+    t2.join(30)
+    assert errs, "second statement should have been rejected typed"
+    # restore generous knobs for the fixture teardown's own statements
+    db.config.set("admission_queue_limit", 64)
+    db.config.set("admission_slots", 32)
+
+
+def test_queue_s_in_audit_and_admission_wait_span(db):
+    db.config.set("admission_slots", 1)
+    db.config.set("admission_tenant_slots", 1)
+    s1, s2 = db.session(), db.session()
+    s1.execute("create table t (a int primary key)")
+    s1.execute("insert into t values (1)")
+    # hold the only slot directly through the controller
+    hold_ctx = StmtCtx(session_id=999, tenant="sys")
+    db.admission.acquire(hold_ctx)
+    res: dict = {}
+
+    def waiter():
+        res["r"] = s2.execute("select count(*) from t")
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.12)
+    db.admission.release(hold_ctx)
+    th.join(10)
+    assert res["r"].rows() == [(1,)]
+    recs = [r for r in db.audit.recent(None)
+            if r.session_id == s2.session_id and "count" in r.sql]
+    assert recs and recs[-1].queue_s > 0.05
+    rows = s1.execute(
+        "select span_name from gv$trace").arrays.get("span_name", [])
+    assert "admission.wait" in set(rows)
+
+
+def test_px_downgrade_counted_and_visible(db, monkeypatch):
+    """Drained px_admission: the downgrade is counted, span-tagged and
+    shown by EXPLAIN ANALYZE instead of silently running serial."""
+    from oceanbase_tpu.server import metrics as qmetrics
+
+    s = db.session()
+    _load_big(s, n=2000)
+    s.execute("set px_dop = 2")
+    t = db.tenant("sys")
+    # drain the quota (non-blocking: grab everything grantable)
+    grabbed = 0
+    while t.px_admission.acquire(blocking=False):
+        grabbed += 1
+    try:
+        before = qmetrics.counter_value("admission.px_downgrades")
+        r = s.execute("select sum(b) from big")
+        assert r.rowcount == 1
+        after = qmetrics.counter_value("admission.px_downgrades")
+        assert after > before
+        txt = s.execute(
+            "explain analyze select sum(b) from big").plan_text
+        assert "admission denied" in txt
+    finally:
+        for _ in range(grabbed):
+            t.px_admission.release()
+
+
+def test_gv_tenant_resource_rows(db):
+    s = db.session()
+    s.execute("create table t (a int primary key)")
+    s.execute("insert into t values (1)")
+    r = s.execute("select tenant, slots_total, queue_limit, "
+                  "memstore_limit_bytes, throttle_state "
+                  "from gv$tenant_resource")
+    rows = r.rows()
+    assert len(rows) == 1 and rows[0][0] == "sys"
+    assert rows[0][1] > 0 and rows[0][3] > 0
+    assert rows[0][4] in ("ok", "throttle", "full", "off")
+
+
+def test_show_processlist_states(db):
+    s = db.session()
+    s.execute("create table t (a int primary key)")
+    r = s.execute("show processlist")
+    i = r.names.index("state")
+    states = {row[i] for row in r.rows()}
+    assert states <= {"RUNNING", "QUEUED", "KILLED", "IDLE"}
+    assert "RUNNING" in states  # this statement itself
+
+
+def test_memstore_backpressure_sql(db):
+    """A write flood against a tiny memstore budget while an old open
+    transaction pins the flush horizon (flushes cannot drain): bytes
+    stay under the hard limit, writes fail typed MemstoreFull, and the
+    flood is survivable once the pin commits and the flush catches
+    up."""
+    s = db.session()
+    s.execute("create table w (a int primary key, b string)")
+    # the pin: an ACTIVE transaction with an old snapshot clamps the
+    # flush horizon, so pressure flushes retain the flood's versions
+    pin = db.session()
+    pin.execute("begin")
+    pin.execute("insert into w values (-1, 'pin')")
+    db.config.set("memstore_limit_bytes", 40000)
+    db.config.set("writing_throttle_trigger_pct", 50)
+    db.config.set("writing_throttle_max_sleep_s", 0.001)
+    thr = db.tenant("sys").throttle
+    payload = "y" * 200
+    full = 0
+    for i in range(300):
+        try:
+            s.execute(f"insert into w values ({i}, '{payload}')")
+        except MemstoreFull:
+            full += 1
+    assert full > 0, "the hard limit never engaged under a pinned flush"
+    assert thr.peak_bytes <= 40000, "memstore exceeded its hard limit"
+    assert thr.throttle_sleeps > 0  # the ramp fired before the wall
+    # the flood is survivable: pin commits, the flush catches up,
+    # writes admit again (retry loop = the MemstoreFull contract)
+    pin.execute("commit")
+    for _ in range(20):
+        try:
+            s.execute("insert into w values (100000, 'ok')")
+            break
+        except MemstoreFull:
+            time.sleep(0.02)
+    else:
+        raise AssertionError("writes never recovered after the flush")
+    r = s.execute("select b from w where a = 100000")
+    assert r.rows() == [("ok",)]
+
+
+# ---------------------------------------------------------------------------
+# POLICIES completeness (satellite: no verb ships without an explicit
+# deadline/idempotence decision)
+# ---------------------------------------------------------------------------
+
+
+def test_every_registered_verb_has_explicit_policy():
+    """Every RPC verb registered by net/node.py (including the palf and
+    rebuild handler maps it splices in) must carry an explicit POLICIES
+    entry — POLICIES.get(method, DEFAULT_POLICY) must never be the
+    silent decision for a shipped verb."""
+    import ast as pyast
+
+    from oceanbase_tpu.net.rpc import POLICIES
+
+    def dict_keys_of(path, within=None):
+        with open(path) as f:
+            tree = pyast.parse(f.read())
+        keys = set()
+        for node in pyast.walk(tree):
+            if isinstance(node, pyast.Dict):
+                for k in node.keys:
+                    if isinstance(k, pyast.Constant) and \
+                            isinstance(k.value, str):
+                        keys.add(k.value)
+        return keys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    verbs = set()
+    for rel in ("oceanbase_tpu/net/node.py",
+                "oceanbase_tpu/palf/netcluster.py",
+                "oceanbase_tpu/net/rebuild.py"):
+        verbs |= {k for k in dict_keys_of(os.path.join(repo, rel))
+                  if ("." in k and k.replace(".", "").replace("_", "")
+                      .isalnum() and k.split(".")[0] in (
+                          "das", "dtl", "sql", "node", "cluster",
+                          "recovery", "metrics", "fault", "scrub",
+                          "rebuild", "palf")) or k == "ping"}
+    assert verbs, "verb extraction found nothing — test is broken"
+    missing = sorted(v for v in verbs if v not in POLICIES)
+    assert not missing, (
+        f"verbs with no explicit POLICIES entry: {missing} — add a "
+        f"VerbPolicy (non-idempotent => max_retries=0)")
+
+
+def test_every_live_handler_verb_has_policy(tmp_path):
+    """Belt over the AST suspenders: boot one in-process node and check
+    the REAL handler table against POLICIES."""
+    from oceanbase_tpu.net.node import NodeServer
+    from oceanbase_tpu.net.rpc import POLICIES
+
+    n = NodeServer(1, "127.0.0.1", 0, {}, root=str(tmp_path / "n1"))
+    n.start()  # stop() joins serve_forever — it must have started
+    try:
+        missing = sorted(v for v in n.server.handlers
+                         if v not in POLICIES)
+        assert not missing, missing
+    finally:
+        n.stop()
+
+
+# ---------------------------------------------------------------------------
+# dtl.cancel registry + bounded rpc pool (satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_cancel_registry_idempotent_tombstones():
+    from oceanbase_tpu.px.dtl import CancelRegistry
+
+    reg = CancelRegistry()
+    assert reg.cancel("tok") is False     # unknown: plants a tombstone
+    assert reg.cancel("tok") is True      # idempotent re-apply
+    assert reg.entry("tok").is_set()      # late fragment sees the flag
+    # bounded: never grows past MAX_ENTRIES
+    for i in range(CancelRegistry.MAX_ENTRIES + 10):
+        reg.entry(f"t{i}")
+    assert len(reg._entries) <= CancelRegistry.MAX_ENTRIES
+
+
+def test_rpc_pool_bounded_typed_error_and_lru_close():
+    from oceanbase_tpu.net.rpc import (
+        ConnPoolExhausted,
+        RpcClient,
+        RpcServer,
+    )
+
+    gate = threading.Event()
+
+    def slow(**kw):
+        gate.wait(5)
+        return "done"
+
+    srv = RpcServer("127.0.0.1", 0,
+                    {"ping": lambda: "pong", "das.pull": slow})
+    srv.start()
+    try:
+        cli = RpcClient("127.0.0.1", srv.port, pool_size=1, max_conns=1)
+        th = threading.Thread(
+            target=lambda: cli.call("das.pull", _deadline_s=10.0))
+        th.start()
+        time.sleep(0.1)  # the slow call owns the only connection
+        t0 = time.monotonic()
+        with pytest.raises(ConnPoolExhausted):
+            cli.call("ping", _deadline_s=0.3)
+        assert time.monotonic() - t0 < 2.0  # typed fail at the deadline
+        gate.set()
+        th.join(5)
+        # after checkin the connection frees: calls work again
+        assert cli.ping()
+        # LRU close on checkin: idle never exceeds pool_size and the
+        # live count never exceeds max_conns
+        assert len(cli._pool) <= 1 and cli._conns <= 1
+        cli.close()
+        assert cli._conns == 0
+    finally:
+        gate.set()
+        srv.stop()
+
+
+def test_rpc_pool_waits_for_free_conn_inside_deadline():
+    from oceanbase_tpu.net.rpc import RpcClient, RpcServer
+
+    srv = RpcServer("127.0.0.1", 0, {"ping": lambda: "pong"})
+    srv.start()
+    try:
+        cli = RpcClient("127.0.0.1", srv.port, pool_size=2, max_conns=2)
+        # fan out more concurrent calls than max_conns: all succeed by
+        # waiting for checkins instead of dialing without bound
+        errs = []
+
+        def call():
+            try:
+                cli.call("ping", _deadline_s=5.0)
+            except Exception as e:  # noqa: BLE001 — captured
+                errs.append(e)
+
+        threads = [threading.Thread(target=call) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert not errs
+        assert cli._conns <= 2
+        cli.close()
+    finally:
+        srv.stop()
