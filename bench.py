@@ -318,6 +318,13 @@ def main():
 
     rows_per_sec = n_rows / dev_time
     platform = jax.devices()[0].platform
+    # resolved-backend provenance: a CPU-fallback run (TPU relay dead)
+    # is visible in the artifact itself, not just the stderr log
+    from oceanbase_tpu.server.backend_info import (
+        last_tpu_probe,
+        resolve_backend,
+    )
+
     rec = {
         "metric": f"tpch_{which}_sf{sf:g}_rows_per_sec_chip",
         "value": round(rows_per_sec, 1),
@@ -327,6 +334,7 @@ def main():
         "numpy_cpu_time_s": round(cpu_time, 4),
         "rows": n_rows,
         "platform": platform,
+        "backend": {**resolve_backend(), "tpu_probe": last_tpu_probe()},
         # baseline fairness: the numpy oracle is single-threaded; on this
         # host that IS the CPU's best (report cores so a skeptic can see)
         "host_nproc": os.cpu_count(),

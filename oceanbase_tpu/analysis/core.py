@@ -18,7 +18,7 @@ from dataclasses import dataclass
 from typing import Callable, Iterable, Sequence
 
 # rule families (each checker documents its rules under one family)
-FAMILIES = ("trace", "mask", "lock", "metric")
+FAMILIES = ("trace", "mask", "lock", "metric", "time")
 
 _PRAGMA_RE = re.compile(r"#\s*obcheck:\s*ok\(([^)]*)\)")
 
@@ -186,10 +186,12 @@ def run_all(files: dict[str, str],
             check_mask_discipline,
         )
         from oceanbase_tpu.analysis.metric_rules import check_metric_rules
+        from oceanbase_tpu.analysis.time_rules import check_time_rules
         from oceanbase_tpu.analysis.trace_safety import check_trace_safety
 
         checkers = (check_trace_safety, check_mask_discipline,
-                    check_lock_order, check_metric_rules)
+                    check_lock_order, check_metric_rules,
+                    check_time_rules)
     az = Analyzer(files)
     findings: list[Finding] = list(az.parse_errors)
     for chk in checkers:

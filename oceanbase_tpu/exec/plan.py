@@ -63,6 +63,13 @@ qmetrics.declare("plan.flops_executed", "counter",
                  "execution (measured device work, the CBO's substrate)")
 qmetrics.declare("plan.bytes_executed", "counter",
                  "cost_analysis bytes-accessed per execution")
+qmetrics.declare("plan.host_s", "histogram",
+                 "host half of the execution split: bind + dispatch "
+                 "until the runtime hands back futures", unit="s")
+qmetrics.declare("plan.device_s", "histogram",
+                 "device half of the execution split: "
+                 "block_until_ready() bracketed at the result boundary "
+                 "(the denominator of achieved_gflops)", unit="s")
 
 
 # ---------------------------------------------------------------------------
@@ -80,7 +87,11 @@ class PlanCacheEntry:
     ``flops``/``bytes_accessed``/``peak_memory`` come from XLA's
     ``cost_analysis()``/``memory_analysis()`` on the most recently
     compiled signature — the measured statistics the cost-based
-    optimizer arc prices against.
+    optimizer arc prices against.  ``device_s_total`` accumulates the
+    block_until_ready() half of the host/device split over
+    ``device_executions`` timed runs, which makes ``achieved_gflops`` /
+    ``achieved_gbps`` *measured* rates (program cost over measured
+    device seconds), not datasheet numbers.
     """
 
     plan_hash: str            # stable digest of the plan fingerprint
@@ -91,11 +102,30 @@ class PlanCacheEntry:
     flops: float = 0.0        # cost_analysis flops (last compile)
     bytes_accessed: float = 0.0  # cost_analysis bytes (last compile)
     peak_memory: int = 0      # memory_analysis arg+temp+output bytes
+    device_s_total: float = 0.0   # summed device half of timed runs
+    host_s_total: float = 0.0     # summed host half (bind + dispatch)
+    device_executions: int = 0    # runs with the time split enabled
+    device_flops: float = 0.0     # flops behind the timed runs
+    device_bytes: float = 0.0     # bytes-accessed behind the timed runs
     created_ts: float = field(default_factory=time.time)
 
     @property
     def hit_count(self) -> int:
         return max(self.executions - self.xla_traces, 0)
+
+    @property
+    def achieved_gflops(self) -> float:
+        """Measured GFLOP/s over the timed executions (0.0 until one)."""
+        if self.device_s_total <= 0.0:
+            return 0.0
+        return self.device_flops / self.device_s_total / 1e9
+
+    @property
+    def achieved_gbps(self) -> float:
+        """Measured GB/s of bytes-accessed over the timed executions."""
+        if self.device_s_total <= 0.0:
+            return 0.0
+        return self.device_bytes / self.device_s_total / 1e9
 
 
 _PLAN_STATS: dict[str, PlanCacheEntry] = {}
@@ -680,7 +710,10 @@ class _PlanExecutable:
         return entry
 
     def call(self, tables):
-        """-> ((out, diag_vals, diag_total, mon_vals), compiled_now)."""
+        """-> ((out, diag_vals, diag_total, mon_vals), compiled_now,
+        flops, bytes_accessed) — the cost-analysis pair is the executed
+        SIGNATURE's, so callers can attribute measured device time to
+        the program that actually ran."""
         sig = _input_signature(tables)
         entry = self._execs.get(sig)
         compiled_now = False
@@ -693,7 +726,7 @@ class _PlanExecutable:
         exe, flops, nbytes, _peak = entry
         qmetrics.inc("plan.flops_executed", int(flops))
         qmetrics.inc("plan.bytes_executed", int(nbytes))
-        return exe(tables), compiled_now
+        return exe(tables), compiled_now, flops, nbytes
 
 
 # per-thread statement-scoped compile marker: the session resets it
@@ -718,6 +751,77 @@ def mark_compiled():
     """For non-execute_plan compile paths (PX shard_map programs) to
     join the same statement-scoped exclusion."""
     _exec_flags.compiled = True
+
+
+# ---------------------------------------------------------------------------
+# host/device time split (the roofline-calibration plane's measurement
+# half): when enabled, execute_plan brackets ``block_until_ready()`` at
+# the existing result boundary so every execution records host_s (bind +
+# dispatch until the runtime hands back futures) and device_s (the wait
+# for the computation itself) separately.  Process-global like the
+# metrics enable flag; Database wires it to ``enable_profiling``.
+# ---------------------------------------------------------------------------
+
+_TIME_SPLIT = True
+
+
+def set_time_split(on: bool):
+    global _TIME_SPLIT
+    _TIME_SPLIT = bool(on)
+
+
+def time_split_enabled() -> bool:
+    return _TIME_SPLIT
+
+
+@dataclass
+class ExecTimes:
+    """Per-statement execution accounting, accumulated across every
+    execute_plan call (retries, granule chunks, spill sub-plans) plus
+    remote DTL fragments folded in via ``add_exec_times``.  ``flops`` /
+    ``bytes`` are the XLA cost_analysis totals of the executed programs
+    — the numerators the roofline prediction prices against ``calls``
+    launches of measured ``device_s``."""
+
+    host_s: float = 0.0
+    device_s: float = 0.0
+    flops: float = 0.0
+    bytes: float = 0.0
+    calls: int = 0
+
+
+def _exec_acc() -> ExecTimes:
+    acc = getattr(_exec_flags, "times", None)
+    if acc is None:
+        acc = _exec_flags.times = ExecTimes()
+    return acc
+
+
+def reset_exec_times():
+    """Statement start: the session clears the accumulator alongside
+    reset_compile_flag()."""
+    _exec_flags.times = ExecTimes()
+
+
+def exec_times() -> ExecTimes:
+    """Snapshot of this thread's statement-scoped accumulator."""
+    acc = _exec_acc()
+    return ExecTimes(acc.host_s, acc.device_s, acc.flops, acc.bytes,
+                     acc.calls)
+
+
+def add_exec_times(host_s: float = 0.0, device_s: float = 0.0,
+                   flops: float = 0.0, bytes: float = 0.0,  # noqa: A002
+                   calls: int = 0):
+    """Fold externally measured work into the statement accumulator —
+    DTL coordinators merge the split their remote fragments shipped
+    back, so a pushed-down statement's device_s covers the cluster."""
+    acc = _exec_acc()
+    acc.host_s += float(host_s)
+    acc.device_s += float(device_s)
+    acc.flops += float(flops)
+    acc.bytes += float(bytes)
+    acc.calls += int(calls)
 
 
 @functools.lru_cache(maxsize=256)
@@ -786,9 +890,48 @@ def execute_plan(plan: PlanNode, tables: dict[str, Relation],
     # the result boundary below (never inside the jit-traced `run` body)
     with qtrace.span("plan.execute", plan_hash=stats.plan_hash) as tsp:
         t0 = time.perf_counter()
-        (out, diag_vals, diag_total, mon_vals), compiled_now = \
-            bundle.call({k: v for k, v in tables.items() if k in needed})
+        (out, diag_vals, diag_total, mon_vals), compiled_now, flops, \
+            nbytes = bundle.call(
+                {k: v for k, v in tables.items() if k in needed})
         stats.executions += 1
+        host_s = time.perf_counter() - t0
+        if compiled_now:
+            # first execution at a signature pays lower()+compile()
+            # inside the window above; that one-time cost is already
+            # attributed (gv$plan_cache.last_compile_s, the xla.compile
+            # span) and must not read as a per-execution dispatch stall
+            # in gv$sql_audit.host_s — the same exclusion the PR 8
+            # plan-history watchdog applies to its latency baselines
+            host_s = max(host_s - stats.last_compile_s, 0.0)
+        device_s = 0.0
+        if _TIME_SPLIT:
+            # the host/device split: dispatch returned futures above;
+            # waiting for one HERE (host side, result boundary — the
+            # same place the overflow check would sync anyway) makes
+            # device_s the computation's own time, not host dispatch.
+            # Blocking ONE output scalar suffices: the plan runs as a
+            # single fused program whose output buffers all fulfill at
+            # completion — and keeps the split's cost O(1), not
+            # O(output tree) (the <=2% profile_bench budget).
+            t1 = time.perf_counter()
+            jax.block_until_ready(  # obcheck: ok(trace.host-sync)
+                diag_total)
+            device_s = time.perf_counter() - t1
+            stats.device_s_total += device_s
+            stats.host_s_total += host_s
+            stats.device_executions += 1
+            stats.device_flops += flops
+            stats.device_bytes += nbytes
+            qmetrics.observe("plan.host_s", host_s, op=root_op)
+            qmetrics.observe("plan.device_s", device_s, op=root_op)
+            tsp.tags["host_s"] = round(host_s, 6)
+            tsp.tags["device_s"] = round(device_s, 6)
+        acc = _exec_acc()
+        acc.host_s += host_s
+        acc.device_s += device_s
+        acc.flops += flops
+        acc.bytes += nbytes
+        acc.calls += 1
         plan_elapsed = time.perf_counter() - t0
         qmetrics.inc("plan.executions", op=root_op)
         qmetrics.observe("plan.execute_s", plan_elapsed, op=root_op)

@@ -523,8 +523,27 @@ def execute_fragment(ts, plan_enc: dict, snapshot: int, part: int,
     rel = host_relation(arrays, valids,
                         {c.name: c.dtype for c in ts.tdef.columns})
     mon = [] if (with_ops or monitor_lanes) else None
+    # host/device split of THIS fragment, shipped back beside the
+    # monitor rows so the coordinator's statement accounting covers the
+    # cluster's device time, not just its own.  Measured as a DELTA of
+    # the thread-local accumulator: a coordinator running a slice
+    # locally (avoided/fallback parts) goes through here on its session
+    # thread, whose statement totals must keep accumulating untouched.
+    from oceanbase_tpu.exec.plan import exec_times
+
+    before = exec_times()
     out = execute_plan(remote, {scan.table: rel}, monitor_out=mon,
                        monitor_collect=with_ops, op_spans=False)
+    after = exec_times()
+    # compact wire shape (bare int list, µs-quantized): the pushdown
+    # reply's whole point is its tiny wire cost vs the snapshot pull —
+    # a keyed float dict per slice would eat a visible slice of that
+    # budget
+    frag_times = [int((after.host_s - before.host_s) * 1e6),
+                  int((after.device_s - before.device_s) * 1e6),
+                  int(after.flops - before.flops),
+                  int(after.bytes - before.bytes),
+                  after.calls - before.calls]
     raw = to_numpy(out)
     r_arrays = {k: v for k, v in raw.items()
                 if not k.startswith("__valid__")}
@@ -544,6 +563,8 @@ def execute_fragment(ts, plan_enc: dict, snapshot: int, part: int,
         # anywhere between this result boundary and the merge — wire,
         # codec, allocator — turns into a local re-run, never rows
         "crc": arrays_crc(r_arrays, r_valids),
+        # [host_us, device_us, flops, bytes, calls] of this fragment
+        "tm": frag_times,
     }
     if with_ops:
         reply["ops"] = [int(r["rows"]) for r in mon]
@@ -613,6 +634,8 @@ class DtlRecord:
     fallback_parts: int = 0    # slices re-run locally AFTER a failure
     avoided_parts: int = 0     # slices routed locally PRE-EMPTIVELY
     elapsed_s: float = 0.0
+    remote_device_s: float = 0.0  # summed device_s shipped by remote
+    #                             # fragments (exec/plan.py split)
     # per-slice attribution (index = part number): output rows, wire
     # bytes (0 for locally-run slices) and wall seconds per slice —
     # partition skew made visible before the CBO has to price it
@@ -900,6 +923,23 @@ class DtlExchange:
                 out = execute_plan(push.rebuilt, {DTL_TABLE: rel},
                                    monitor_out=merge_mon,
                                    monitor_collect=collect)
+            # fold the splits REMOTE fragments shipped back into the
+            # statement's accumulator (locally-run slices already
+            # accumulated on this thread); rec.remote_device_s makes
+            # the cluster's device time visible per exchange
+            from oceanbase_tpu.exec.plan import add_exec_times
+
+            remote_device_s = 0.0
+            for i, _cli in remote:
+                if errors[i] is not None or results[i] is None:
+                    continue  # slice re-ran locally (already counted)
+                tm = results[i].get("tm")
+                if tm and len(tm) == 5:
+                    add_exec_times(host_s=tm[0] * 1e-6,
+                                   device_s=tm[1] * 1e-6,
+                                   flops=tm[2], bytes=tm[3],
+                                   calls=tm[4])
+                    remote_device_s += tm[1] * 1e-6
             rows_shipped = sum(r["rows"] for i, r in enumerate(results)
                                if i > 0 and ship_bytes[i] > 0)
             elapsed = time.monotonic() - m0
@@ -909,6 +949,7 @@ class DtlExchange:
                 rows_shipped=rows_shipped, fallback_parts=fallbacks,
                 avoided_parts=len(avoided_parts) - 1,
                 elapsed_s=elapsed,
+                remote_device_s=round(remote_device_s, 6),
                 slice_rows=[int(r["rows"]) for r in results],
                 slice_bytes=list(ship_bytes),
                 slice_elapsed=[round(s, 6) for s in slice_s])

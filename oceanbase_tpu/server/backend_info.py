@@ -1,0 +1,82 @@
+"""Resolved-backend identity: which hardware is this process ACTUALLY on.
+
+The TPU relay has been dead at every bench probe so far (ROADMAP), which
+made every artifact a CPU-fallback run distinguishable only by log
+archaeology.  This module gives the resolved backend one authoritative
+shape, reused by:
+
+- the Database boot log line (one line per boot, INFO level);
+- the ``gv$backend`` virtual table (the same facts through SQL);
+- ``bench.py`` / ``scripts/sf_parity.py`` / ``scripts/profile_bench.py``
+  artifact tagging, so a JSON line carries its own provenance.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+
+def resolve_backend() -> dict:
+    """-> {platform, device_kind, device_count, cpu_fallback} of the
+    live jax backend; degrades to an 'unavailable' row rather than
+    raising (the virtual table must stay readable mid-outage)."""
+    try:
+        import jax
+
+        devs = jax.devices()
+        platform = devs[0].platform if devs else "unknown"
+        kind = str(getattr(devs[0], "device_kind", "")) if devs else ""
+        count = len(devs)
+    except Exception as e:  # noqa: BLE001 — a wedged relay must not
+        # take the observability plane down with it
+        return {"platform": "unavailable", "device_kind": str(e)[:80],
+                "device_count": 0, "cpu_fallback": True}
+    # cpu_fallback: a TPU pool was configured for this process but the
+    # resolved platform is cpu — the "relay dead" condition made visible
+    wanted_tpu = bool(os.environ.get("PALLAS_AXON_POOL_IPS")) or \
+        "tpu" in os.environ.get("JAX_PLATFORMS", "").lower()
+    return {"platform": platform, "device_kind": kind,
+            "device_count": count,
+            "cpu_fallback": platform == "cpu" and wanted_tpu}
+
+
+def last_tpu_probe(repo_root: str | None = None) -> dict:
+    """Outcome of the most recent ``scripts/tpu_probe.py`` run: the
+    latest ``TPU_PROBE_*.log``'s last VERDICT line (the probe's one-line
+    conclusion).  -> {log, verdict} with empty strings when no probe log
+    exists (e.g. an installed package outside the repo)."""
+    if repo_root is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+        repo_root = os.path.dirname(os.path.dirname(here))
+    logs = sorted(glob.glob(os.path.join(repo_root, "TPU_PROBE_*.log")))
+    if not logs:
+        return {"log": "", "verdict": ""}
+    path = logs[-1]
+    verdict = ""
+    try:
+        with open(path, errors="replace") as fh:
+            for line in fh:
+                if line.startswith("VERDICT"):
+                    verdict = line.strip()
+    except OSError:
+        pass
+    return {"log": os.path.basename(path), "verdict": verdict[:200]}
+
+
+def backend_summary(units=None) -> str:
+    """One-line boot summary: backend kind, device count, calibration
+    age, last tpu_probe outcome."""
+    b = resolve_backend()
+    probe = last_tpu_probe()
+    age = units.age_s() if units is not None else -1.0
+    bits = [
+        f"platform={b['platform']}",
+        f"device_kind={b['device_kind'] or '-'}",
+        f"devices={b['device_count']}",
+        f"cpu_fallback={int(b['cpu_fallback'])}",
+        "calibration_age_s="
+        + (f"{age:.0f}" if age >= 0 else "uncalibrated"),
+        f"tpu_probe={probe['verdict'] or 'never-ran'}",
+    ]
+    return " ".join(bits)

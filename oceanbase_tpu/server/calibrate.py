@@ -1,0 +1,458 @@
+"""Roofline calibration: measured machine constants per backend.
+
+The pricing substrate (gv$plan_cache flops/bytes, PR 7) gives every
+compiled program an XLA cost-analysis pair, but turning flops/bytes into
+*predicted seconds* needs the machine constants nothing measures from a
+datasheet: achieved FLOP/s, achieved bytes/s, and the per-launch
+dispatch overhead of the live backend (plus the rpc cost per shipped
+byte for distributed plans).  TVM (https://arxiv.org/pdf/1802.04799)
+calibrates its cost model from measured runs and Tensor Processing
+Primitives (https://arxiv.org/pdf/2104.05755) frames exactly this
+roofline-style per-backend efficiency accounting; this module is that
+measurement plane.
+
+A small canonical kernel suite — stream copy, masked reduce, segment
+group-by, searchsorted probe, small matmul — runs across the
+shape-bucket ladder on the live backend.  Every kernel is mask
+disciplined (dead pad lanes cannot influence its result; the poison
+verifier covers each one), so the probes measure the same masked-lane
+programs the engine actually runs.  From the measurements:
+
+- ``peak_bytes_s``      — best achieved bytes/s (the bandwidth roof,
+                          set by the streaming kernels);
+- ``eff_bytes_s``       — WORST achieved bytes/s across the relational
+                          suite (segment group-by, searchsorted probe
+                          set it): relational programs are gather/
+                          scatter-bound, so their effective bandwidth
+                          roof is an order below stream copy, and
+                          pricing them at stream rate underestimates
+                          every plan by that order;
+- ``peak_flops_s``      — best achieved FLOP/s (the compute roof, set
+                          by the matmul probe);
+- ``launch_overhead_s`` — dispatch + sync floor of a trivial program;
+- ``rpc_s_per_byte``    — derived from the PR 7 rpc rtt histograms
+                          (rpc.call_s sums over rpc.bytes), 0.0 on a
+                          single-node process.
+
+``predict_seconds`` is the roofline model the plan monitor q-errors
+against measured device time: ``max(flops/F, bytes/B_eff) + calls * L``
+— the per-operator-type residuals it leaves land in
+``gv$time_calibration`` as named correction factors.
+
+Constants persist as ``cost_units.json`` under the database root,
+crc64-checksummed per the PR 9 contract: a corrupt file raises
+``CorruptionError`` and is quarantined (never served), after which the
+probe simply runs again.  The probe itself is cached process-wide — the
+constants describe the backend, not a Database instance — so a test
+suite booting hundreds of Databases pays for one probe.
+
+Runs at first boot (micro preset) and on ``ALTER SYSTEM CALIBRATE``
+(full ladder); knob ``enable_calibration`` gates both.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oceanbase_tpu.native import crc64
+from oceanbase_tpu.server import metrics as qmetrics
+from oceanbase_tpu.storage.integrity import CorruptionError
+from oceanbase_tpu.vector.column import bucket_capacity
+
+UNITS_FILE = "cost_units.json"
+
+#: probe ladder presets: rungs for the vector kernels (rows) and the
+#: matmul probe (square dim).  "boot" is sized to stay well under a
+#: second so Database() startup (and the whole test suite, which boots
+#: one process-wide probe) barely notices; "full" is the ALTER SYSTEM
+#: CALIBRATE / scripts/profile_bench.py ladder.
+PRESETS = {
+    "boot": {"rows": (65536,), "matmul": (128,), "repeats": 3},
+    "full": {"rows": (16384, 65536, 262144, 1048576),
+             "matmul": (128, 256), "repeats": 5},
+}
+
+
+# ---------------------------------------------------------------------------
+# the canonical kernel suite (mask-disciplined: dead lanes are inert)
+# ---------------------------------------------------------------------------
+
+
+def k_stream_copy(x, mask):
+    """Pure streaming: read + write one lane per row; dead lanes emit
+    the identity (0) so poisoned pads cannot reach the output."""
+    return jnp.where(mask, x, jnp.zeros((), x.dtype))
+
+
+def k_masked_reduce(x, mask):
+    """Bandwidth-bound reduction with the mask identity-element rule."""
+    return jnp.sum(jnp.where(mask, x, jnp.zeros((), x.dtype)))
+
+
+def k_segment_groupby(codes, vals, mask, n_groups: int):
+    """Group-by core: masked segment-sum; dead lanes route to an
+    overflow segment that is sliced away."""
+    seg = jnp.where(mask, codes, n_groups)
+    sums = jax.ops.segment_sum(
+        jnp.where(mask, vals, jnp.zeros((), vals.dtype)), seg,
+        num_segments=n_groups + 1)
+    return sums[:n_groups]
+
+
+def k_searchsorted(keys, probes, mask):
+    """Join-probe core: binary search of probes in a sorted key column;
+    dead probe lanes are sanitized to the identity before the search
+    and zeroed after, so poisoned pads never steer a comparison."""
+    idx = jnp.searchsorted(
+        keys, jnp.where(mask, probes, jnp.zeros((), probes.dtype)))
+    return jnp.where(mask, idx, jnp.zeros((), idx.dtype))
+
+
+def k_matmul(a, b, mask):
+    """Compute-bound probe (the FLOP roof): dead rows of ``a`` zero out
+    before the contraction, so their garbage never reaches the MXU
+    accumulate."""
+    a2 = a * mask[:, None].astype(a.dtype)
+    return a2 @ b
+
+
+def _ladder(rungs, floor: int = 64, growth: float = 2.0):
+    """Snap the requested rungs to the shape-bucket ladder so the probe
+    measures the same capacities relations actually materialize at."""
+    return tuple(bucket_capacity(r, floor, growth) for r in rungs)
+
+
+def probe_cases(preset: str = "boot"):
+    """-> list of (name, rows, build() -> (fn, args),
+    analytic_flops, analytic_bytes).  ``build`` materializes the probe
+    inputs on device and closes static params (segment count) into
+    ``fn``; the analytic cost pair is the fallback where a backend's
+    cost_analysis comes back empty."""
+    p = PRESETS[preset]
+    cases = []
+    for n in _ladder(p["rows"]):
+        def build_stream(n=n):
+            return k_stream_copy, (jnp.arange(n, dtype=jnp.float32),
+                                   _probe_mask(n))
+
+        cases.append(("stream_copy", n, build_stream,
+                      float(n), float(n * 4 * 2 + n)))
+
+        def build_reduce(n=n):
+            return k_masked_reduce, (jnp.arange(n, dtype=jnp.float32),
+                                     _probe_mask(n))
+
+        cases.append(("masked_reduce", n, build_reduce,
+                      float(2 * n), float(n * 4 + n)))
+
+        def build_seg(n=n):
+            g = max(min(n // 64, 4096), 8)
+            codes = jnp.asarray(np.arange(n) % g, dtype=jnp.int32)
+            vals = jnp.arange(n, dtype=jnp.float32)
+
+            def fn(c, v, m):
+                return k_segment_groupby(c, v, m, g)
+
+            return fn, (codes, vals, _probe_mask(n))
+
+        cases.append(("segment_groupby", n, build_seg,
+                      float(2 * n), float(n * 8 + n)))
+
+        def build_ss(n=n):
+            keys = jnp.arange(n, dtype=jnp.int32)
+            probes = jnp.asarray((np.arange(n) * 7919) % n,
+                                 dtype=jnp.int32)
+            return k_searchsorted, (keys, probes, _probe_mask(n))
+
+        cases.append(("searchsorted", n, build_ss,
+                      float(n * max(int(np.log2(max(n, 2))), 1)),
+                      float(n * 12)))
+    for m in p["matmul"]:
+        def build_mm(m=m):
+            a = jnp.asarray(np.random.default_rng(7).standard_normal(
+                (m, m)), dtype=jnp.float32)
+            b = jnp.asarray(np.random.default_rng(11).standard_normal(
+                (m, m)), dtype=jnp.float32)
+            return k_matmul, (a, b, jnp.ones((m,), dtype=jnp.bool_))
+
+        cases.append(("small_matmul", m, build_mm,
+                      float(2 * m * m * m), float(3 * m * m * 4)))
+    return cases
+
+
+def _probe_mask(n: int):
+    """Probe relations carry ~1/8 dead pad lanes, mirroring a padded
+    bucket, so the mask path is part of what gets measured."""
+    return jnp.asarray(np.arange(n) % 8 != 7)
+
+
+# ---------------------------------------------------------------------------
+# measurement
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CostUnits:
+    """Per-backend machine constants (the gv$cost_units payload)."""
+
+    backend: str = "unknown"
+    device_kind: str = ""
+    device_count: int = 0
+    peak_flops_s: float = 0.0
+    peak_bytes_s: float = 0.0
+    eff_bytes_s: float = 0.0
+    launch_overhead_s: float = 0.0
+    rpc_s_per_byte: float = 0.0
+    calibrated_ts: float = 0.0     # wall clock (record timestamp)
+    preset: str = "boot"
+    probe_s: float = 0.0           # how long the probe itself took
+    measurements: list = field(default_factory=list)
+
+    def age_s(self) -> float:
+        return max(time.time() - self.calibrated_ts, 0.0) \
+            if self.calibrated_ts else -1.0
+
+
+def _launch_overhead_s(repeats: int = 7) -> float:
+    """Dispatch + sync floor: a compiled 1-element add, median of
+    repeats (median, not min: the constant is the overhead a typical
+    launch PAYS, and the 1-core bench host schedules noisily)."""
+    x = jnp.zeros((1,), dtype=jnp.float32)
+    exe = jax.jit(lambda v: v + 1.0).lower(x).compile()
+    jax.block_until_ready(exe(x))
+    ts = []
+    for _ in range(max(repeats, 3)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(exe(x))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def _cost_pair(exe, fallback_flops: float, fallback_bytes: float):
+    from oceanbase_tpu.exec.plan import _xla_analysis
+
+    flops, nbytes, _peak = _xla_analysis(exe)
+    return (flops if flops > 0 else fallback_flops,
+            nbytes if nbytes > 0 else fallback_bytes)
+
+
+def run_probe(preset: str = "boot") -> CostUnits:
+    """Run the canonical suite on the live backend -> fresh CostUnits.
+    Pure measurement: no caching, no persistence (ensure_units layers
+    those)."""
+    t_start = time.perf_counter()
+    devs = jax.devices()
+    units = CostUnits(
+        backend=devs[0].platform if devs else "unknown",
+        device_kind=str(getattr(devs[0], "device_kind", ""))
+        if devs else "",
+        device_count=len(devs),
+        preset=preset,
+        calibrated_ts=time.time(),
+    )
+    units.launch_overhead_s = _launch_overhead_s()
+    repeats = PRESETS[preset]["repeats"]
+    best_bytes_s = 0.0
+    best_flops_s = 0.0
+    worst_bytes_s = float("inf")
+    for name, rows, build, fb_flops, fb_bytes in probe_cases(preset):
+        fn, args = build()
+        try:
+            exe = jax.jit(fn).lower(*args).compile()
+            jax.block_until_ready(exe(*args))  # warm
+        except Exception as e:  # noqa: BLE001 — a backend without a
+            # kernel degrades that measurement, never the probe
+            units.measurements.append(
+                {"kernel": name, "rows": rows, "error": str(e)[:120]})
+            continue
+        flops, nbytes = _cost_pair(exe, fb_flops, fb_bytes)
+        ts = []
+        for _ in range(max(repeats, 2)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(exe(*args))
+            ts.append(time.perf_counter() - t0)
+        # min-of-repeats: the measurement wants the machine's capability,
+        # not the scheduler's mood (1-core host, ROADMAP bench notes)
+        raw_s = min(ts)
+        dev_s = max(raw_s - units.launch_overhead_s, 1e-9)
+        units.measurements.append({
+            "kernel": name, "rows": int(rows),
+            "flops": float(flops), "bytes": float(nbytes),
+            "device_s": round(dev_s, 9), "raw_s": round(raw_s, 9),
+            "gflops": round(flops / dev_s / 1e9, 4),
+            "gbps": round(nbytes / dev_s / 1e9, 4)})
+        if name in ("stream_copy", "masked_reduce") and nbytes > 0:
+            best_bytes_s = max(best_bytes_s, nbytes / dev_s)
+        if name != "small_matmul" and nbytes > 0:
+            # the relational kernels' WORST rate is the effective
+            # bandwidth roof for plan-shaped programs (gather/scatter
+            # bound), the one predict_seconds prices with
+            worst_bytes_s = min(worst_bytes_s, nbytes / dev_s)
+        if flops > 0:
+            best_flops_s = max(best_flops_s, flops / dev_s)
+    units.peak_bytes_s = best_bytes_s
+    units.eff_bytes_s = (worst_bytes_s
+                         if worst_bytes_s != float("inf") else 0.0)
+    units.peak_flops_s = best_flops_s
+    units.rpc_s_per_byte = rpc_s_per_byte()
+    units.probe_s = round(time.perf_counter() - t_start, 4)
+    return units
+
+
+def rpc_s_per_byte() -> float:
+    """Wire cost per byte from the PR 7 metrics plane: total rpc rtt
+    seconds over total rpc payload bytes (0.0 before any rpc ran)."""
+    snap = qmetrics.snapshot()
+    rtt_s = sum(h.sum for (n, _lbl), h in snap["hists"].items()
+                if n == "rpc.call_s")
+    nbytes = sum(v for (n, _lbl), v in snap["counters"].items()
+                 if n == "rpc.bytes")
+    return rtt_s / nbytes if nbytes > 0 else 0.0
+
+
+# ---------------------------------------------------------------------------
+# the roofline model (what the CBO will price plans with)
+# ---------------------------------------------------------------------------
+
+
+def predict_seconds(units: CostUnits, flops: float, nbytes: float,
+                    calls: int = 1) -> float:
+    """Roofline prediction: ``max(flops/F, bytes/B_eff) + calls * L``
+    with the EFFECTIVE relational bandwidth as the byte roof (falling
+    back to stream peak where a probe did not measure one).  Monotone
+    in flops, bytes and calls by construction (the property tests
+    pin)."""
+    t = 0.0
+    if units.peak_flops_s > 0:
+        t = max(t, max(flops, 0.0) / units.peak_flops_s)
+    bytes_s = units.eff_bytes_s or units.peak_bytes_s
+    if bytes_s > 0:
+        t = max(t, max(nbytes, 0.0) / bytes_s)
+    return t + max(int(calls), 1) * max(units.launch_overhead_s, 0.0)
+
+
+def time_q_error(pred_s: float, actual_s: float) -> float:
+    """Symmetric misprediction factor, >= 1.0 (0.0 = nothing to
+    compare) — the time twin of exec/plan.py::q_error."""
+    if pred_s <= 0.0 or actual_s <= 0.0:
+        return 0.0
+    return max(pred_s / actual_s, actual_s / pred_s)
+
+
+# ---------------------------------------------------------------------------
+# persistence (PR 9 contract: checksummed, never serve poisoned)
+# ---------------------------------------------------------------------------
+
+
+def _units_path(root: str) -> str:
+    return os.path.join(root, UNITS_FILE)
+
+
+def save_units(root: str, units: CostUnits) -> str:
+    """Persist with an embedded crc64 of the canonical payload bytes."""
+    payload = json.dumps(asdict(units), sort_keys=True)
+    doc = {"crc": crc64(payload.encode()), "units": json.loads(payload)}
+    path = _units_path(root)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def load_units(root: str) -> CostUnits | None:
+    """-> persisted CostUnits, None when absent.  A file that fails its
+    checksum raises CorruptionError — corrupt machine constants must
+    never price a plan."""
+    path = _units_path(root)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+        body = doc["units"]
+        want = int(doc["crc"])
+    except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+        raise CorruptionError(
+            f"cost_units.json unreadable: {e}", kind="cost_units",
+            path=path) from e
+    got = crc64(json.dumps(body, sort_keys=True).encode())
+    if got != want:
+        raise CorruptionError(
+            f"cost_units.json checksum mismatch (stored {want}, "
+            f"computed {got})", kind="cost_units", path=path)
+    known = {f.name for f in CostUnits.__dataclass_fields__.values()}
+    return CostUnits(**{k: v for k, v in body.items() if k in known})
+
+
+def quarantine_units(root: str) -> str | None:
+    """Move a corrupt cost_units.json aside (kept for forensics, like
+    the scrub plane's quarantine) so the next probe starts clean."""
+    path = _units_path(root)
+    if not os.path.exists(path):
+        return None
+    dst = path + ".corrupt"
+    try:
+        os.replace(path, dst)
+        return dst
+    except OSError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# process-wide cache (the constants describe the backend, not a
+# Database instance)
+# ---------------------------------------------------------------------------
+
+_PROC_UNITS: CostUnits | None = None
+_PROC_LOCK = threading.Lock()
+
+
+def get_cost_units() -> CostUnits | None:
+    """The process's current machine constants (None until a boot probe
+    or ALTER SYSTEM CALIBRATE ran)."""
+    return _PROC_UNITS
+
+
+def set_cost_units(units: CostUnits | None):
+    global _PROC_UNITS
+    _PROC_UNITS = units
+
+
+def ensure_units(root: str | None = None, preset: str = "boot",
+                 force: bool = False) -> CostUnits:
+    """Boot/CALIBRATE entry point: adopt valid persisted constants for
+    this backend, else probe once per process; persist to ``root`` when
+    given.  ``force`` re-probes (ALTER SYSTEM CALIBRATE)."""
+    global _PROC_UNITS
+    with _PROC_LOCK:
+        backend = jax.default_backend()
+        if not force:
+            if _PROC_UNITS is not None and \
+                    _PROC_UNITS.backend == backend:
+                if root and not os.path.exists(_units_path(root)):
+                    save_units(root, _PROC_UNITS)
+                return _PROC_UNITS
+            if root:
+                try:
+                    loaded = load_units(root)
+                except CorruptionError:
+                    quarantine_units(root)
+                    loaded = None
+                if loaded is not None and loaded.backend == backend:
+                    _PROC_UNITS = loaded
+                    return loaded
+        units = run_probe(preset)
+        _PROC_UNITS = units
+        if root:
+            save_units(root, units)
+        return units

@@ -270,6 +270,22 @@ DEF("enable_rate_limit", True, "bool",
     "memstore_limit_bytes, raise MemstoreFull at the hard limit "
     "(≙ write throttling)")
 
+# device-time profiling + roofline calibration (exec/plan.py split,
+# server/calibrate.py, server/profiler.py)
+DEF("enable_profiling", True, "bool",
+    "host/device time split: execute_plan brackets block_until_ready() "
+    "at the result boundary so every execution records host_s (bind + "
+    "dispatch) and device_s (compute) separately — feeds gv$sql_audit "
+    "host_s/device_s, gv$plan_cache achieved_gflops/achieved_gbps, the "
+    "time q-error ledger, and the PROFILE deep trace; hot-reloadable "
+    "via ALTER SYSTEM SET (scripts/profile_bench.py prices the toggle)")
+DEF("enable_calibration", True, "bool",
+    "roofline cost calibration (server/calibrate.py): run the "
+    "canonical probe suite at first boot (constants persisted "
+    "checksummed as cost_units.json, surfaced as gv$cost_units) and "
+    "allow ALTER SYSTEM CALIBRATE re-probes; off = no machine "
+    "constants, roofline predictions and time q-errors degrade to 0")
+
 # diagnostics
 DEF("enable_metrics", True, "bool",
     "cluster-wide metrics plane (server/metrics.py): named counters, "

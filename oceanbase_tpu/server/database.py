@@ -27,6 +27,7 @@ from oceanbase_tpu.server.monitor import (
     PlanHistory,
     PlanMonitor,
     SqlAudit,
+    TimeCalibration,
     WaitEvents,
 )
 from oceanbase_tpu.server.tenant import Tenant
@@ -55,6 +56,31 @@ class Database:
             lambda k, v: qmetrics.set_enabled(bool(v))
             if k == "enable_metrics" else None)
 
+        # host/device time split (exec/plan.py): process-global like the
+        # metrics flag; scripts/profile_bench.py prices the toggle
+        from oceanbase_tpu.exec import plan as qplan
+
+        qplan.set_time_split(bool(self.config["enable_profiling"]))
+        self.config.watch(
+            lambda k, v: qplan.set_time_split(bool(v))
+            if k == "enable_profiling" else None)
+
+        # roofline calibration (server/calibrate.py): adopt persisted
+        # machine constants or run the first-boot probe (cached
+        # process-wide — the constants describe the backend, not this
+        # instance); a corrupt cost_units.json is quarantined and
+        # re-probed, never served (PR 9 contract)
+        from oceanbase_tpu.server import calibrate as qcalibrate
+
+        self.cost_units = None
+        if bool(self.config["enable_calibration"]):
+            try:
+                self.cost_units = qcalibrate.ensure_units(root)
+            except Exception:  # noqa: BLE001 — calibration is
+                # observability: a probe failure degrades predictions
+                # to zeros, never boot
+                self.cost_units = None
+
         # observability (cluster-wide)
         self.audit = SqlAudit(int(self.config["sql_audit_queue_size"]))
         self.plan_monitor = PlanMonitor()
@@ -65,6 +91,12 @@ class Database:
             int(self.config["plan_feedback_entries"]))
         self.plan_history = PlanHistory(
             int(self.config["plan_history_entries"]))
+        # roofline accounting per operator type + PROFILE capture store
+        # (gv$time_calibration / gv$device_profile)
+        from oceanbase_tpu.server.profiler import DeviceProfileStore
+
+        self.time_calibration = TimeCalibration()
+        self.device_profiles = DeviceProfileStore()
         # full-link trace ring (gv$trace / SHOW TRACE; server/trace.py)
         self.trace_registry = TraceRegistry(
             int(self.config["trace_ring_spans"]))
@@ -121,6 +153,17 @@ class Database:
                             os.path.isdir(os.path.join(tdir, name)):
                         self.create_tenant(name, wal_replicas=wal_replicas,
                                            _boot=True)
+
+        # one boot log line naming the RESOLVED backend: CPU-fallback
+        # runs (the "TPU relay dead" condition) become a logged fact
+        # instead of log archaeology; gv$backend serves the same info
+        # through SQL
+        import logging
+
+        from oceanbase_tpu.server.backend_info import backend_summary
+
+        logging.getLogger("oceanbase_tpu.server").info(
+            "boot backend: %s", backend_summary(self.cost_units))
 
     def _tenant_weight(self, name: str) -> int:
         t = self.tenants.get(name)

@@ -102,7 +102,8 @@ class JobScheduler:
             for name, j in list(self.jobs.items()):
                 if now < j["next"]:
                     continue
-                t0 = time.time()
+                ts = time.time()       # record timestamp (wall)
+                t0 = time.monotonic()  # elapsed source (step-proof)
                 ok, err = True, ""
                 try:
                     j["fn"]()
@@ -110,10 +111,10 @@ class JobScheduler:
                     ok, err = False, f"{type(e).__name__}: {e}"
                     j["failures"] += 1
                 j["runs"] += 1
-                j["last_s"] = time.time() - t0
+                j["last_s"] = time.monotonic() - t0
                 j["next"] = time.monotonic() + j["interval"]
                 self.history.append({
-                    "ts": t0, "job": name, "ok": ok, "error": err,
+                    "ts": ts, "job": name, "ok": ok, "error": err,
                     "elapsed_s": j["last_s"]})
                 del self.history[:-1000]
 

@@ -46,6 +46,10 @@ class AuditRecord:
     compile_s: float = 0.0
     trace_id: str = ""         # joins gv$trace / SHOW TRACE
     queue_s: float = 0.0       # admission queue wait (overload plane)
+    # host/device split (exec/plan.py, enable_profiling): dispatch
+    # stalls vs device work, separable in slow-statement triage
+    host_s: float = 0.0
+    device_s: float = 0.0
 
 
 class SqlAudit:
@@ -90,6 +94,13 @@ class PlanMonitorRecord:
     retries: int = 0           # CapacityOverflow re-plans before success
     spill_bytes: int = 0       # temp-file bytes when the spill tier ran
     path: str = "serial"       # serial | spill | px | dtl
+    # host/device split + roofline prediction (the time q-error beside
+    # the cardinality one; exec/plan.py split, server/calibrate.py
+    # model).  0.0 = split off / uncalibrated.
+    host_s: float = 0.0        # bind + dispatch (summed over calls)
+    device_s: float = 0.0      # block_until_ready waits (summed)
+    pred_s: float = 0.0        # roofline max(flops/F, bytes/B) + L*calls
+    time_q: float = 0.0        # max(pred/dev, dev/pred), >= 1.0
 
 
 class PlanMonitor:
@@ -133,10 +144,13 @@ class PlanMonitor:
 
     def record(self, plan_hash: str, op_stats: list, total_s: float,
                logical_hash: str = "", retries: int = 0,
-               spill_bytes: int = 0, path: str = "serial"):
+               spill_bytes: int = 0, path: str = "serial",
+               host_s: float = 0.0, device_s: float = 0.0,
+               pred_s: float = 0.0, time_q: float = 0.0):
         rec = PlanMonitorRecord(time.time(), plan_hash, op_stats,
                                 total_s, logical_hash, retries,
-                                spill_bytes, path)
+                                spill_bytes, path, host_s, device_s,
+                                pred_s, time_q)
         with self._lock:
             self._ring.append(rec)
 
@@ -328,6 +342,74 @@ class PlanHistory:
                     "p99_s": st["p99"],
                     "regressed": ent["regressed"],
                     "regress_count": ent["regress_count"]})
+            return out
+
+
+class TimeCalibration:
+    """Per-operator-type roofline accounting (the calibration table the
+    CBO arc will read): for every monitored execution, the plan's ROOT
+    operator type accumulates predicted vs measured device seconds and
+    a time-q-error distribution.  Where the q-error sits near 1, the
+    roofline already prices that plan shape in seconds; where it
+    doesn't, the gap is a named, queryable correction factor
+    (dev_s_sum / pred_s_sum) rather than folklore."""
+
+    def __init__(self, capacity: int = 256):
+        from oceanbase_tpu.server.metrics import Histogram
+
+        self._hist_cls = Histogram
+        self.capacity = int(capacity)
+        #: op -> {count, pred_s_sum, dev_s_sum, host_s_sum, tq_hist,
+        #:        worst_tq, last_ts}
+        self._store: collections.OrderedDict[str, dict] = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def observe(self, op: str, pred_s: float, device_s: float,
+                host_s: float = 0.0):
+        if not op or pred_s <= 0.0 or device_s <= 0.0:
+            return  # uncalibrated / split off: nothing to learn
+        tq = max(pred_s / device_s, device_s / pred_s)
+        with self._lock:
+            ent = self._store.get(op)
+            if ent is None:
+                while len(self._store) >= max(self.capacity, 1):
+                    self._store.popitem(last=False)
+                ent = self._store[op] = {
+                    "count": 0, "pred_s_sum": 0.0, "dev_s_sum": 0.0,
+                    "host_s_sum": 0.0, "tq_hist": self._hist_cls(),
+                    "worst_tq": 0.0, "last_ts": 0.0}
+            else:
+                self._store.move_to_end(op)
+            ent["count"] += 1
+            ent["pred_s_sum"] += float(pred_s)
+            ent["dev_s_sum"] += float(device_s)
+            ent["host_s_sum"] += float(host_s)
+            ent["tq_hist"].observe(tq)
+            if tq > ent["worst_tq"]:
+                ent["worst_tq"] = tq
+            ent["last_ts"] = time.time()
+
+    def rows(self) -> list:
+        """Flat gv$time_calibration rows (percentiles from bucket
+        counts, never stored samples)."""
+        from oceanbase_tpu.server.metrics import hist_stats
+
+        with self._lock:
+            out = []
+            for op, ent in self._store.items():
+                st = hist_stats(ent["tq_hist"])
+                correction = (ent["dev_s_sum"] / ent["pred_s_sum"]
+                              if ent["pred_s_sum"] > 0 else 0.0)
+                out.append({
+                    "op": op, "count": ent["count"],
+                    "pred_s_sum": ent["pred_s_sum"],
+                    "dev_s_sum": ent["dev_s_sum"],
+                    "host_s_sum": ent["host_s_sum"],
+                    "correction": correction,
+                    "tq_p50": st["p50"], "tq_p95": st["p95"],
+                    "worst_tq": ent["worst_tq"],
+                    "last_ts": ent["last_ts"]})
             return out
 
 

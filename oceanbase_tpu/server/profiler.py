@@ -1,0 +1,215 @@
+"""Deep device profiling: PROFILE <statement> -> per-kernel rows.
+
+Reference analog: the SQL-plan-monitor's per-operator timing made
+kernel-real — ``PROFILE <query>`` wraps one statement in a
+``jax.profiler`` device trace, parses the captured trace into
+per-kernel rows (name, occurrences, total/avg time, share of device
+time), and stores them keyed by the statement's trace_id so
+``gv$device_profile`` joins against gv$sql_audit / gv$trace.  ``SHOW
+PROFILE`` renders the session's most recent capture.
+
+The capture degrades gracefully everywhere the backend can't profile:
+the statement always executes; a profiler failure just yields a note
+instead of rows.  The parser reads the Chrome-trace export
+(``*.trace.json.gz``) with nothing but stdlib — no tensorflow /
+tensorboard dependency — and classifies events into
+
+- ``kernel``  — XLA computation events (fusions, reductions, ...): the
+  rows the roofline plane cares about;
+- ``runtime`` — executor machinery (TfrtCpuExecutable, ThunkExecutor,
+  thread-pool listeners);
+- ``host``    — python-side TraceMe frames (``$file.py:line``).
+
+Only one trace can be active per process (a jax.profiler constraint):
+concurrent PROFILEs serialize on a non-blocking lock — the loser runs
+unprofiled with a note, it never deadlocks a session.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+
+_PROFILE_LOCK = threading.Lock()
+
+#: event-name prefixes that are executor/compiler machinery, not kernels
+_RUNTIME_PREFIXES = (
+    "TfrtCpu", "PjitFunction", "ThunkExecutor", "ThreadpoolListener",
+    "ParseArguments", "ExecuteHelper", "PjRt", "CopyToDevice",
+    "TransferTo", "BufferFromHost", "Execute", "program_shape",
+    "backend_compile", "CpuCompiler", "Codegen", "TaskDispatcher",
+    "XlaCompile", "ThreadPool", "BufferAllocations", "Stream",
+    "RunBackend", "optimization", "HloPass",
+)
+
+MAX_ROWS_PER_PROFILE = 256
+
+
+@dataclass
+class DeviceProfile:
+    """One PROFILE capture (joined to the statement by trace_id)."""
+
+    trace_id: str
+    sql: str
+    backend: str
+    ts: float                  # wall clock (record timestamp)
+    rows: list = field(default_factory=list)
+    note: str = ""
+
+
+class DeviceProfileStore:
+    """Bounded ring of PROFILE captures (the gv$device_profile store)."""
+
+    def __init__(self, capacity: int = 64):
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, prof: DeviceProfile):
+        with self._lock:
+            self._ring.append(prof)
+
+    def recent(self, n: int | None = None) -> list:
+        with self._lock:
+            items = list(self._ring)
+        return items if n is None else items[-n:]
+
+    def get(self, trace_id: str) -> DeviceProfile | None:
+        with self._lock:
+            for p in reversed(self._ring):
+                if p.trace_id == trace_id:
+                    return p
+        return None
+
+
+# ---------------------------------------------------------------------------
+# capture
+# ---------------------------------------------------------------------------
+
+
+def profile_statement(run):
+    """Execute ``run()`` under a device trace.  -> (result, rows, note).
+
+    The statement's own exception always propagates; profiler failures
+    never do.  When the profiler cannot even start (another trace
+    active, backend without one), the statement runs unprofiled."""
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        return run(), [], "profiler busy (another PROFILE in flight)"
+    try:
+        tmpdir = tempfile.mkdtemp(prefix="obtpu_profile_")
+        try:
+            try:
+                import jax
+
+                cm = jax.profiler.trace(tmpdir)
+                cm.__enter__()
+            except Exception as e:  # noqa: BLE001 — no profiler on
+                # this backend: the statement still runs
+                return run(), [], (f"profiler unavailable: "
+                                   f"{type(e).__name__}: {e}"[:200])
+            note = ""
+            try:
+                out = run()
+            finally:
+                try:
+                    cm.__exit__(None, None, None)
+                except Exception as e:  # noqa: BLE001
+                    note = (f"profiler stop failed: "
+                            f"{type(e).__name__}: {e}"[:200])
+            rows = [] if note else parse_trace_dir(tmpdir)
+            if not rows and not note:
+                note = "profiler produced no device events"
+            return out, rows, note
+        finally:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+    finally:
+        _PROFILE_LOCK.release()
+
+
+# ---------------------------------------------------------------------------
+# parse (stdlib only: the Chrome-trace export)
+# ---------------------------------------------------------------------------
+
+
+def _classify(plane: str, name: str) -> str:
+    if name.startswith("$") or ".py:" in name:
+        return "host"
+    if plane.startswith("/device:"):
+        return "kernel"
+    if any(name.startswith(p) for p in _RUNTIME_PREFIXES):
+        return "runtime"
+    return "kernel"
+
+
+def parse_trace_dir(tmpdir: str) -> list:
+    """Newest ``*.trace.json.gz`` under a jax.profiler log dir ->
+    aggregated per-kernel rows (sorted by total time, bounded)."""
+    pats = (os.path.join(tmpdir, "plugins", "profile", "*",
+                         "*.trace.json.gz"),
+            os.path.join(tmpdir, "plugins", "profile", "*",
+                         "*.trace.json"))
+    files = sorted(f for p in pats for f in glob.glob(p))
+    if not files:
+        return []
+    path = files[-1]
+    try:
+        if path.endswith(".gz"):
+            with gzip.open(path, "rb") as fh:
+                doc = json.loads(fh.read())
+        else:
+            with open(path) as fh:
+                doc = json.load(fh)
+    except (OSError, json.JSONDecodeError, EOFError):
+        return []
+    events = doc.get("traceEvents", []) or []
+    planes: dict[int, str] = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            planes[e.get("pid")] = (e.get("args") or {}).get("name", "")
+    agg: dict[tuple, list] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = e.get("name", "")
+        if not name:
+            continue
+        plane = planes.get(e.get("pid"), "")
+        kind = _classify(plane, name)
+        if kind == "host":
+            continue  # python frames: gv$trace already covers the host
+        dur_s = float(e.get("dur", 0)) * 1e-6  # chrome trace: µs
+        k = (plane, name, kind)
+        cur = agg.get(k)
+        if cur is None:
+            agg[k] = [1, dur_s]
+        else:
+            cur[0] += 1
+            cur[1] += dur_s
+    kernel_total = sum(v[1] for (_pl, _n, kind), v in agg.items()
+                      if kind == "kernel") or 0.0
+    rows = []
+    for (plane, name, kind), (occ, total) in agg.items():
+        rows.append({
+            "device": plane, "kernel": name, "kind": kind,
+            "occurrences": int(occ), "total_s": total,
+            "avg_s": total / occ if occ else 0.0,
+            "pct": (100.0 * total / kernel_total
+                    if kind == "kernel" and kernel_total > 0 else 0.0)})
+    rows.sort(key=lambda r: -r["total_s"])
+    return rows[:MAX_ROWS_PER_PROFILE]
+
+
+def make_profile(trace_id: str, sql: str, rows: list,
+                 note: str = "") -> DeviceProfile:
+    from oceanbase_tpu.server.backend_info import resolve_backend
+
+    return DeviceProfile(trace_id=trace_id, sql=sql[:200],
+                         backend=resolve_backend()["platform"],
+                         ts=time.time(), rows=rows, note=note)

@@ -37,6 +37,10 @@ class VirtualTables:
             "gv$plan_feedback": self.plan_feedback,
             "gv$plan_history": self.plan_history,
             "gv$plan_cache": self.plan_cache,
+            "gv$cost_units": self.cost_units,
+            "gv$time_calibration": self.time_calibration,
+            "gv$device_profile": self.device_profile,
+            "gv$backend": self.backend,
             "gv$px_exchange": self.px_exchange,
             "gv$cluster_health": self.cluster_health,
             "gv$recovery": self.recovery,
@@ -83,6 +87,12 @@ class VirtualTables:
             # statement sat QUEUED before its slot was granted
             "queue_s": np.array([getattr(r, "queue_s", 0.0)
                                  for r in recs], np.float64),
+            # host/device split (enable_profiling): dispatch stalls vs
+            # device work, separable in slow-statement triage
+            "host_s": np.array([getattr(r, "host_s", 0.0)
+                                for r in recs], np.float64),
+            "device_s": np.array([getattr(r, "device_s", 0.0)
+                                  for r in recs], np.float64),
         }
 
     def tenant_resource(self):
@@ -207,7 +217,11 @@ class VirtualTables:
                              r["rows"], r.get("q_error", 0.0),
                              r.get("elapsed_s", 0.0), rec.retries,
                              r.get("spill_bytes", rec.spill_bytes),
-                             rec.path, rec.total_s))
+                             rec.path, rec.total_s,
+                             getattr(rec, "host_s", 0.0),
+                             getattr(rec, "device_s", 0.0),
+                             getattr(rec, "pred_s", 0.0),
+                             getattr(rec, "time_q", 0.0)))
         return {
             "ts": np.array([r[0] for r in rows], np.float64),
             "plan_hash": _obj(r[1] for r in rows),
@@ -224,6 +238,14 @@ class VirtualTables:
             "path": _obj(r[11] for r in rows),
             "plan_elapsed_s": np.array([r[12] for r in rows],
                                        np.float64),
+            # host/device split + roofline (the TIME q-error beside the
+            # cardinality one; whole-statement values repeated per op
+            # row like plan_elapsed_s)
+            "host_s": np.array([r[13] for r in rows], np.float64),
+            "device_s": np.array([r[14] for r in rows], np.float64),
+            "pred_s": np.array([r[15] for r in rows], np.float64),
+            "time_q_error": np.array([r[16] for r in rows],
+                                     np.float64),
         }
 
     def plan_feedback(self):
@@ -327,8 +349,145 @@ class VirtualTables:
                                         for e in entries], np.float64),
             "peak_memory": np.array([e.peak_memory for e in entries],
                                     np.int64),
+            # host/device split accumulated over timed executions
+            # (enable_profiling): measured flops per measured device
+            # second — the roofline numbers, not datasheet ones
+            "host_s_total": np.array([e.host_s_total for e in entries],
+                                     np.float64),
+            "device_s_total": np.array([e.device_s_total
+                                        for e in entries], np.float64),
+            "device_executions": np.array(
+                [e.device_executions for e in entries], np.int64),
+            "achieved_gflops": np.array([e.achieved_gflops
+                                         for e in entries], np.float64),
+            "achieved_gbps": np.array([e.achieved_gbps
+                                       for e in entries], np.float64),
             "created_ts": np.array([e.created_ts for e in entries],
                                    np.float64),
+        }
+
+    def cost_units(self):
+        """Calibrated machine constants + the probe measurements behind
+        them (server/calibrate.py; checksummed on disk per the PR 9
+        contract): kind='constant' rows are the roofline inputs
+        (peak flops/s, bytes/s, launch overhead, rpc per-byte);
+        kind='probe' rows are the per-kernel-per-rung measurements."""
+        units = getattr(self.db, "cost_units", None)
+        rows = []
+        if units is not None:
+            base = (units.backend, units.device_kind,
+                    units.calibrated_ts, units.preset)
+            for name, value, unit in (
+                    ("peak_flops_s", units.peak_flops_s, "flops/s"),
+                    ("peak_bytes_s", units.peak_bytes_s, "bytes/s"),
+                    ("eff_bytes_s", units.eff_bytes_s, "bytes/s"),
+                    ("launch_overhead_s", units.launch_overhead_s, "s"),
+                    ("rpc_s_per_byte", units.rpc_s_per_byte, "s/byte")):
+                rows.append((*base, "constant", name, 0, 0.0, 0.0, 0.0,
+                             float(value), unit))
+            for m in units.measurements:
+                if "error" in m:
+                    continue
+                rows.append((*base, "probe", m["kernel"],
+                             int(m["rows"]), float(m["flops"]),
+                             float(m["bytes"]), float(m["device_s"]),
+                             float(m["gflops"]), "gflops"))
+        return {
+            "backend": _obj(r[0] for r in rows),
+            "device_kind": _obj(r[1] for r in rows),
+            "calibrated_ts": np.array([r[2] for r in rows], np.float64),
+            "preset": _obj(r[3] for r in rows),
+            "kind": _obj(r[4] for r in rows),
+            "name": _obj(r[5] for r in rows),
+            "rows": np.array([r[6] for r in rows], np.int64),
+            "flops": np.array([r[7] for r in rows], np.float64),
+            "bytes": np.array([r[8] for r in rows], np.float64),
+            "device_s": np.array([r[9] for r in rows], np.float64),
+            "value": np.array([r[10] for r in rows], np.float64),
+            "unit": _obj(r[11] for r in rows),
+        }
+
+    def time_calibration(self):
+        """Per-operator-type roofline accounting (the calibration table
+        the CBO arc reads): predicted vs measured device seconds and
+        the time-q-error distribution per plan root operator."""
+        tc = getattr(self.db, "time_calibration", None)
+        rows = tc.rows() if tc is not None else []
+        return {
+            "operator": _obj(r["op"] for r in rows),
+            "executions": np.array([r["count"] for r in rows],
+                                   np.int64),
+            "pred_s_sum": np.array([r["pred_s_sum"] for r in rows],
+                                   np.float64),
+            "device_s_sum": np.array([r["dev_s_sum"] for r in rows],
+                                     np.float64),
+            "host_s_sum": np.array([r["host_s_sum"] for r in rows],
+                                   np.float64),
+            # measured/predicted ratio: the correction factor a CBO
+            # multiplies its roofline price by for this operator shape
+            "correction": np.array([r["correction"] for r in rows],
+                                   np.float64),
+            "time_q_p50": np.array([r["tq_p50"] for r in rows],
+                                   np.float64),
+            "time_q_p95": np.array([r["tq_p95"] for r in rows],
+                                   np.float64),
+            "worst_time_q": np.array([r["worst_tq"] for r in rows],
+                                     np.float64),
+            "last_ts": np.array([r["last_ts"] for r in rows],
+                                np.float64),
+        }
+
+    def device_profile(self):
+        """Per-kernel rows of every PROFILE capture (server/profiler.py)
+        joined to the statement by trace_id (≙ the SQL plan monitor's
+        per-operator timing, taken down to real device kernels)."""
+        store = getattr(self.db, "device_profiles", None)
+        profs = store.recent() if store is not None else []
+        rows = []
+        for p in profs:
+            for r in p.rows:
+                rows.append((p.trace_id, p.ts, p.backend, p.sql,
+                             r["device"], r["kernel"], r["kind"],
+                             r["occurrences"], r["total_s"], r["avg_s"],
+                             r["pct"]))
+        return {
+            "trace_id": _obj(r[0] for r in rows),
+            "ts": np.array([r[1] for r in rows], np.float64),
+            "backend": _obj(r[2] for r in rows),
+            "sql": _obj(r[3] for r in rows),
+            "device": _obj(r[4] for r in rows),
+            "kernel": _obj(r[5] for r in rows),
+            "kind": _obj(r[6] for r in rows),
+            "occurrences": np.array([r[7] for r in rows], np.int64),
+            "total_s": np.array([r[8] for r in rows], np.float64),
+            "avg_s": np.array([r[9] for r in rows], np.float64),
+            "pct_device": np.array([r[10] for r in rows], np.float64),
+        }
+
+    def backend(self):
+        """The resolved backend this process is ACTUALLY on — CPU
+        fallback (the 'TPU relay dead' condition) becomes a queryable
+        fact beside calibration age and the last tpu_probe verdict."""
+        from oceanbase_tpu.server.backend_info import (
+            last_tpu_probe,
+            resolve_backend,
+        )
+
+        b = resolve_backend()
+        probe = last_tpu_probe()
+        units = getattr(self.db, "cost_units", None)
+        age = units.age_s() if units is not None else -1.0
+        return {
+            "platform": _obj([b["platform"]]),
+            "device_kind": _obj([b["device_kind"]]),
+            "device_count": np.array([b["device_count"]], np.int64),
+            "cpu_fallback": np.array([bool(b["cpu_fallback"])]),
+            # -1.0 = never calibrated in this process
+            "calibration_age_s": np.array([age], np.float64),
+            "calibration_preset": _obj(
+                [units.preset if units is not None else ""]),
+            "tpu_probe_log": _obj([probe["log"]]),
+            "tpu_probe_verdict": _obj([probe["verdict"]]),
         }
 
     def px_exchange(self):
@@ -357,6 +516,11 @@ class VirtualTables:
                 np.int64),
             "elapsed_s": np.array([r.elapsed_s for r in recs],
                                   np.float64),
+            # device_s the remote fragments shipped back beside their
+            # monitor rows (the cluster half of the host/device split)
+            "remote_device_s": np.array(
+                [getattr(r, "remote_device_s", 0.0) for r in recs],
+                np.float64),
             # per-slice attribution: output-row balance across the
             # exchange's slices (skew = max/mean; 0.0 = no slice data)
             "max_slice_rows": np.array(

@@ -262,9 +262,20 @@ class AlterTableStmt:
 
 @dataclass
 class AlterSystemStmt:
-    action: str            # set | major_freeze | minor_freeze | checkpoint
+    action: str    # set | major_freeze | minor_freeze | checkpoint
+    #              # | calibrate (re-run the roofline probe suite)
     name: Optional[str] = None
     value: object = None
+
+
+@dataclass
+class ProfileStmt:
+    """PROFILE <statement>: execute the wrapped statement under a
+    jax.profiler device trace; the parsed per-kernel rows land in
+    gv$device_profile keyed by this statement's trace_id (SHOW PROFILE
+    renders the most recent one)."""
+
+    stmt: object
 
 
 @dataclass

@@ -208,6 +208,11 @@ class Parser:
                 raise ParseError(
                     f"expected a session id after KILL at {t.pos}")
             return ast.KillStmt(kind, int(t.value))
+        if self.peek().kind == "ident" and self.peek().value == "profile":
+            # PROFILE <statement>: run it under a device trace
+            # (gv$device_profile rows keyed by the statement's trace_id)
+            self.next()
+            return ast.ProfileStmt(self.parse_statement())
         if self.at_kw("set"):
             return self.parse_set()
         if self.at_kw("alter"):
@@ -238,6 +243,8 @@ class Parser:
                 return ast.ShowStmt("trace")
             if self._accept_word("metrics"):
                 return ast.ShowStmt("metrics")
+            if self._accept_word("profile"):
+                return ast.ShowStmt("profile")
             self.expect_kw("tables")
             return ast.ShowTablesStmt()
         if self.at_kw("describe"):
@@ -1013,6 +1020,10 @@ class Parser:
             return ast.AlterSystemStmt("minor_freeze")
         if self.accept_kw("freeze"):
             return ast.AlterSystemStmt("minor_freeze")
+        if self._accept_word("calibrate"):
+            # re-run the roofline probe suite on the live backend
+            # (server/calibrate.py; refreshes gv$cost_units)
+            return ast.AlterSystemStmt("calibrate")
         t = self.peek()
         raise ParseError(f"unsupported ALTER SYSTEM at {t.pos}")
 

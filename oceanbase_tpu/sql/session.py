@@ -153,6 +153,8 @@ class Session:
                        ast.DeleteStmt, ast.CallStmt, ast.LoadDataStmt)
 
     def _needs_admission(self, stmt) -> bool:
+        if isinstance(stmt, ast.ProfileStmt):
+            return self._needs_admission(stmt.stmt)  # PROFILE runs it
         if isinstance(stmt, self._ADMITTED_STMTS):
             return True
         if isinstance(stmt, ast.ExplainStmt) and \
@@ -192,6 +194,11 @@ class Session:
         t0 = time.monotonic()      # duration source (step-proof)
         err = ""
         out = None
+        # host/device split accumulator: statement-scoped, so audit and
+        # plan-monitor rows attribute exactly this statement's work
+        from oceanbase_tpu.exec import plan as qplan
+
+        qplan.reset_exec_times()
         tctx = qtrace.start_trace(self.db)
         self._ash_state.update(
             active=True, sql=sql, state="executing",
@@ -271,6 +278,7 @@ class Session:
                     getattr(self.db, "audit", None) is not None:
                 from oceanbase_tpu.server.monitor import AuditRecord
 
+                times = qplan.exec_times()
                 self.db.audit.record(AuditRecord(
                     sql=sql, session_id=self.session_id,
                     tenant=getattr(self.tenant, "name", ""),
@@ -280,6 +288,7 @@ class Session:
                     compile_s=self._last_compile_s,
                     trace_id=trace_id,
                     queue_s=ctx.queue_s if ctx is not None else 0.0,
+                    host_s=times.host_s, device_s=times.device_s,
                 ))
 
     def _materialize_virtuals(self, stmt):
@@ -344,6 +353,8 @@ class Session:
             for _, _, rhs in s.setops:
                 walk_sel(rhs)
 
+        if isinstance(stmt, ast.ProfileStmt):
+            stmt = stmt.stmt
         if isinstance(stmt, ast.ExplainStmt):
             stmt = stmt.stmt
         if isinstance(stmt, ast.SelectStmt):
@@ -363,6 +374,8 @@ class Session:
         if isinstance(stmt, ast.ExplainStmt):
             return self._explain(stmt.stmt, params,
                                  analyze=getattr(stmt, "analyze", False))
+        if isinstance(stmt, ast.ProfileStmt):
+            return self._profile(stmt, params)
         if isinstance(stmt, ast.CreateTableStmt):
             return self._create_table(stmt)
         if isinstance(stmt, ast.DropTableStmt):
@@ -556,6 +569,8 @@ class Session:
                 return self._show_trace()
             if stmt.what == "metrics":
                 return self._show_metrics()
+            if stmt.what == "profile":
+                return self._show_profile()
             if stmt.what == "processlist":
                 # admission-plane states surface MySQL-style: QUEUED
                 # (waiting for a slot), RUNNING, KILLED (flagged, still
@@ -643,6 +658,33 @@ class Session:
             return _ok()
         if self.db is None:
             raise ValueError("ALTER SYSTEM needs a Database")
+        if stmt.action == "calibrate":
+            # re-run the roofline probe suite on the live backend
+            # (full ladder) and persist the refreshed machine constants
+            if not bool(self.db.config["enable_calibration"]):
+                raise ValueError(
+                    "enable_calibration is off (ALTER SYSTEM SET "
+                    "enable_calibration = true first)")
+            from oceanbase_tpu.server import calibrate as qcalibrate
+
+            units = qcalibrate.ensure_units(self.db.root, preset="full",
+                                            force=True)
+            self.db.cost_units = units
+            names = ["backend", "peak_gflops", "peak_gbps",
+                     "eff_gbps", "launch_overhead_us",
+                     "rpc_s_per_byte", "probe_s"]
+            vals = [units.backend,
+                    f"{units.peak_flops_s / 1e9:.3f}",
+                    f"{units.peak_bytes_s / 1e9:.3f}",
+                    f"{units.eff_bytes_s / 1e9:.3f}",
+                    f"{units.launch_overhead_s * 1e6:.2f}",
+                    f"{units.rpc_s_per_byte:.3e}",
+                    f"{units.probe_s:.3f}"]
+            return Result(
+                ["constant", "value"],
+                {"constant": np.array(names, dtype=object),
+                 "value": np.array(vals, dtype=object)},
+                {}, {}, rowcount=len(names))
         eng = self._engine
         # flush at the horizon, not gts-now: versions newer than a live
         # transaction's snapshot must stay in the memtables or its
@@ -986,6 +1028,72 @@ class Session:
             {"metric": np.array(lines, dtype=object)},
             {}, {"metric": SqlType.string()}, rowcount=len(lines))
 
+    def _profile(self, stmt: ast.ProfileStmt, params=None) -> Result:
+        """PROFILE <statement>: execute it under a jax.profiler device
+        trace; parsed per-kernel rows land in gv$device_profile keyed
+        by this statement's trace_id (SHOW PROFILE shows them).  The
+        statement's own result (and errors) pass through unchanged;
+        backends without a profiler degrade to a note."""
+        from oceanbase_tpu.server import profiler as qprofiler
+        from oceanbase_tpu.server import trace as qtrace
+
+        store = (getattr(self.db, "device_profiles", None)
+                 if self.db is not None else None)
+        profiling_on = (self.db is not None
+                        and bool(self.db.config["enable_profiling"]))
+        if store is None or not profiling_on:
+            # no store / knob off: run the statement, skip the capture
+            return self.execute_stmt(stmt.stmt, params)
+        tctx = qtrace.current()
+        if tctx is not None:
+            trace_id = tctx.trace_id
+        else:
+            # query tracing off: mint a standalone capture id so the
+            # gv$device_profile rows stay joinable (to each other and
+            # to SHOW PROFILE), just not to gv$trace/gv$sql_audit
+            import uuid
+
+            trace_id = uuid.uuid4().hex[:16]
+        sql = self._ash_state.get("sql", "")
+        out, rows, note = qprofiler.profile_statement(
+            lambda: self.execute_stmt(stmt.stmt, params))
+        store.record(qprofiler.make_profile(trace_id, sql, rows, note))
+        self._last_profile_trace_id = trace_id
+        return out
+
+    def _show_profile(self) -> Result:
+        """SHOW PROFILE: this session's most recent PROFILE capture as
+        per-kernel rows (total/avg time, share of device time)."""
+        store = (getattr(self.db, "device_profiles", None)
+                 if self.db is not None else None)
+        tid = getattr(self, "_last_profile_trace_id", "")
+        prof = store.get(tid) if (store is not None and tid) else None
+        rows = prof.rows if prof is not None else []
+        note = prof.note if prof is not None else \
+            "no PROFILE captured in this session"
+        if not rows and note:
+            rows = [{"device": "", "kernel": f"({note})", "kind": "note",
+                     "occurrences": 0, "total_s": 0.0, "avg_s": 0.0,
+                     "pct": 0.0}]
+        return Result(
+            ["device", "kernel", "kind", "occurrences", "total_ms",
+             "avg_us", "pct_device"],
+            {"device": np.array([r["device"] for r in rows],
+                                dtype=object),
+             "kernel": np.array([r["kernel"] for r in rows],
+                                dtype=object),
+             "kind": np.array([r["kind"] for r in rows], dtype=object),
+             "occurrences": np.array([r["occurrences"] for r in rows],
+                                     np.int64),
+             "total_ms": np.array([r["total_s"] * 1e3 for r in rows],
+                                  np.float64),
+             "avg_us": np.array([r["avg_s"] * 1e6 for r in rows],
+                                np.float64),
+             "pct_device": np.array([r["pct"] for r in rows],
+                                    np.float64)},
+            {}, {"device": SqlType.string(), "kernel": SqlType.string(),
+                 "kind": SqlType.string()}, rowcount=len(rows))
+
     def _show_trace(self) -> Result:
         """SHOW TRACE: the last kept statement trace rendered as an
         indented span tree (≙ SHOW TRACE over the flt span store).
@@ -1288,10 +1396,17 @@ class Session:
         path = ("dtl" if self._last_dtl
                 else "px" if self._last_px else "serial")
         if monitor is not None and mon_collect:
+            # roofline prediction vs the measured device half of this
+            # statement (server/calibrate.py): the TIME q-error beside
+            # the cardinality one, aggregated per root-operator type
+            # into gv$time_calibration for the CBO arc
+            times, pred_s, time_q = self._roofline(plan)
             self.db.plan_monitor.record(
                 plan.fingerprint()[:64] if hasattr(plan, "fingerprint")
                 else "", monitor, exec_elapsed,
-                logical_hash=lhash, retries=attempt, path=path)
+                logical_hash=lhash, retries=attempt, path=path,
+                host_s=times.host_s, device_s=times.device_s,
+                pred_s=pred_s, time_q=time_q)
             if feedback_on and monitor and path == "serial":
                 # teach the feedback store from the serial ledger only:
                 # PX/DTL rows are positioned against rewritten plans, so
@@ -1737,9 +1852,15 @@ class Session:
                         "q_error": _qe(root_est, n_out),
                         "elapsed_s": elapsed,
                         "spill_bytes": stats.bytes}]
+            # the spill tier's plans are the heaviest ones: the time
+            # ledger must cover them too (device_s from the chunk
+            # programs execute_plan drove; pred covers the same work)
+            times, pred_s, time_q = self._roofline(plan)
             self.db.plan_monitor.record(
                 plan_hash, op_rows, elapsed, logical_hash=_lh(plan),
-                spill_bytes=stats.bytes, path="spill")
+                spill_bytes=stats.bytes, path="spill",
+                host_s=times.host_s, device_s=times.device_s,
+                pred_s=pred_s, time_q=time_q)
         return self._materialize_host(arrays, valids, dtypes, outputs)
 
     def _catalog_provider(self, name: str):
@@ -1812,6 +1933,33 @@ class Session:
             out_t[out_name] = t
         return Result(names, out_a, out_v, out_t, rowcount=n)
 
+    def _roofline(self, plan):
+        """Roofline prediction for THIS statement's accumulated device
+        work -> (ExecTimes, pred_s, time_q); records the pair into the
+        per-operator-type calibration table.  Degrades to zeros when
+        the split is off or THIS database is uncalibrated (the
+        per-Database units, not the process cache: a database booted
+        with enable_calibration=false must predict nothing, matching
+        what its gv$cost_units/gv$backend report)."""
+        from oceanbase_tpu.exec import plan as qplan
+        from oceanbase_tpu.server import calibrate as qcalibrate
+
+        times = qplan.exec_times()
+        pred_s = time_q = 0.0
+        units = (getattr(self.db, "cost_units", None)
+                 if self.db is not None else None)
+        if units is not None and times.device_s > 0.0 and \
+                times.calls > 0:
+            pred_s = qcalibrate.predict_seconds(
+                units, times.flops, times.bytes, times.calls)
+            time_q = qcalibrate.time_q_error(pred_s, times.device_s)
+            tc = (getattr(self.db, "time_calibration", None)
+                  if self.db is not None else None)
+            if tc is not None:
+                tc.observe(type(plan).__name__, pred_s, times.device_s,
+                           host_s=times.host_s)
+        return times, pred_s, time_q
+
     def _explain(self, stmt, params, analyze: bool = False) -> Result:
         if not isinstance(stmt, ast.SelectStmt):
             raise NotImplementedError("EXPLAIN supports SELECT")
@@ -1875,6 +2023,16 @@ class Session:
 
                 row_counts = dict(zip(
                     (id(n) for n in monitored_postorder(plan)), monitor))
+                # the time q-error beside the cardinality one: roofline
+                # prediction vs this statement's measured device half
+                times, pred_s, time_q = self._roofline(plan)
+                if times.device_s > 0.0:
+                    spill_line += (
+                        f"\nroofline: [pred={pred_s:.3e}s "
+                        f"dev={times.device_s:.3e}s "
+                        f"host={times.host_s:.3e}s "
+                        + (f"tq={time_q:.2f}]" if time_q > 0.0
+                           else "tq=uncalibrated]"))
                 if self.db is not None and \
                         getattr(self.db, "plan_monitor", None) is not None:
                     from oceanbase_tpu.exec.plan import (
@@ -1885,7 +2043,9 @@ class Session:
                         plan.fingerprint()[:64], monitor,
                         time.monotonic() - an0,
                         logical_hash=_lh(plan), retries=attempt,
-                        path="serial")
+                        path="serial",
+                        host_s=times.host_s, device_s=times.device_s,
+                        pred_s=pred_s, time_q=time_q)
         text = format_plan(plan, row_counts=row_counts) + spill_line
         if analyze and self.tenant is not None and self._px_dop() > 1:
             # surface the px_admission verdict the statement would get

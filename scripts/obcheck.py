@@ -52,6 +52,7 @@ from oceanbase_tpu.analysis.mask_discipline import (  # noqa: E402
 from oceanbase_tpu.analysis.metric_rules import (  # noqa: E402
     check_metric_rules,
 )
+from oceanbase_tpu.analysis.time_rules import check_time_rules  # noqa: E402
 from oceanbase_tpu.analysis.trace_safety import check_trace_safety  # noqa: E402
 
 CHECKERS = {
@@ -59,6 +60,7 @@ CHECKERS = {
     "mask": check_mask_discipline,
     "lock": check_lock_order,
     "metric": check_metric_rules,
+    "time": check_time_rules,
 }
 
 
@@ -73,11 +75,11 @@ def main(argv=None) -> int:
     ap.add_argument("--root", default=REPO, help="repo root to scan")
     ap.add_argument("--baseline", default=core.BASELINE_PATH,
                     help="baseline file path")
-    ap.add_argument("--rules", default="trace,mask,lock,metric",
+    ap.add_argument("--rules", default="trace,mask,lock,metric,time",
                     help="comma-separated rule families to run")
     args = ap.parse_args(argv)
 
-    t0 = time.time()
+    t0 = time.monotonic()
     files = load_package_files(args.root)
     selected = [r.strip() for r in args.rules.split(",")
                 if r.strip() in CHECKERS]
@@ -107,7 +109,7 @@ def main(argv=None) -> int:
             "new": len(new),
             "baselined": len(findings) - len(new),
             "by_rule": {k: by_rule[k] for k in sorted(by_rule)},
-            "duration_s": round(time.time() - t0, 3),
+            "duration_s": round(time.monotonic() - t0, 3),
         }))
     if not args.json or new:
         report = new if args.ci else findings

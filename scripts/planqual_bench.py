@@ -75,9 +75,9 @@ def bench_qerror_coverage() -> dict:
     from oceanbase_tpu.bench.tpch_queries import QUERIES
     from oceanbase_tpu.server import Database
 
-    t0 = time.time()
+    t0 = time.monotonic()
     tables, types = gen_tpch(sf=SF)
-    gen_s = time.time() - t0
+    gen_s = time.monotonic() - t0
     root = tempfile.mkdtemp(prefix="planqual_cov_")
     try:
         db = Database(root)
@@ -91,7 +91,7 @@ def bench_qerror_coverage() -> dict:
             s.execute(f"analyze table {name}")
         per_query = {}
         worst = {"q": None, "op": "", "q_error": 0.0}
-        t0 = time.time()
+        t0 = time.monotonic()
         for qnum in sorted(QUERIES):
             s.execute(QUERIES[qnum])
             rec = db.plan_monitor.recent(1)[-1]
@@ -108,7 +108,7 @@ def bench_qerror_coverage() -> dict:
             if qmax.get("q_error", 0.0) > worst["q_error"]:
                 worst = {"q": qnum, "op": qmax["op"],
                          "q_error": round(qmax["q_error"], 2)}
-        run_s = time.time() - t0
+        run_s = time.monotonic() - t0
         all_covered = all(v["operators"] == v["with_qerror"]
                           for v in per_query.values())
         db.close()

@@ -51,7 +51,7 @@ def run_loop(root: str, steps: int, rows_per_step: int,
               f"{'true' if buckets else 'false'}")
     s.execute("create table t (id int primary key, v int, grp int)")
     results = []
-    t0 = time.time()
+    t0 = time.monotonic()
     next_id = 0
     for _step in range(steps):
         vals = ", ".join(
@@ -60,7 +60,7 @@ def run_loop(root: str, steps: int, rows_per_step: int,
         next_id += rows_per_step
         s.execute(f"insert into t values {vals}")
         results.append(s.execute(QUERY).rows())
-    elapsed = time.time() - t0
+    elapsed = time.monotonic() - t0
     # snapshot the python-side counters BEFORE the gv$plan_cache query
     # itself executes a plan; the virtual table materializes its rows
     # from the same pre-execution snapshot, so the two must agree

@@ -45,16 +45,16 @@ OUT = os.path.join(os.path.dirname(__file__), "..",
 
 
 def main():
-    t0 = time.time()
+    t0 = time.monotonic()
     print(f"generating TPC-H SF={SF} ...", flush=True)
     tables, types = gen_tpch(sf=SF)
-    gen_s = time.time() - t0
+    gen_s = time.monotonic() - t0
     print(f"  done in {gen_s:.1f}s "
           f"(lineitem={len(tables['lineitem']['l_orderkey'])} rows)",
           flush=True)
 
     sess = Session()
-    t0 = time.time()
+    t0 = time.monotonic()
     for name, arrays in tables.items():
         sess.catalog.load_numpy(
             name, arrays,
@@ -64,10 +64,10 @@ def main():
     # reference's DBMS_STATS gather ahead of benchmarking
     for name in tables:
         sess.execute(f"analyze table {name}")
-    load_engine_s = time.time() - t0
-    t0 = time.time()
+    load_engine_s = time.monotonic() - t0
+    t0 = time.monotonic()
     conn = load_sqlite(tables, types)
-    load_oracle_s = time.time() - t0
+    load_oracle_s = time.monotonic() - t0
     print(f"loads: engine+analyze {load_engine_s:.1f}s, "
           f"oracle {load_oracle_s:.1f}s", flush=True)
 
@@ -75,22 +75,22 @@ def main():
     n_ok = 0
     for qnum in sorted(QUERIES):
         sql = QUERIES[qnum]
-        t0 = time.time()
+        t0 = time.monotonic()
         want = run_oracle(conn, sql)
-        oracle_s = time.time() - t0
+        oracle_s = time.monotonic() - t0
         # per-query device attribution: the XLA cost_analysis counters
         # (exec/plan.py) delta'd across the query — measured flops and
         # bytes-accessed the cost-based-optimizer arc prices against
         f0 = qmetrics.counter_value("plan.flops_executed")
         b0 = qmetrics.counter_value("plan.bytes_executed")
-        t0 = time.time()
+        t0 = time.monotonic()
         try:
             got = sess.execute(sql).rows()
-            engine_s = time.time() - t0
+            engine_s = time.monotonic() - t0
             ordered = "order by" in sql.lower() and qnum not in (2, 18, 21)
             ok, why = rows_match(got, want, ordered=ordered)
         except Exception as e:  # noqa: BLE001 — record, keep going
-            engine_s = time.time() - t0
+            engine_s = time.monotonic() - t0
             ok, why = False, f"{type(e).__name__}: {e}"
             got = []
         n_ok += bool(ok)
@@ -106,12 +106,16 @@ def main():
               f"oracle={oracle_s:.2f}s gflops={flops / 1e9:.2f}"
               + ("" if ok else f"  [{why[:120]}]"), flush=True)
 
+    # resolved-backend provenance (CPU-fallback runs tag themselves)
+    from oceanbase_tpu.server.backend_info import (  # noqa: E402
+        last_tpu_probe, resolve_backend)
+
     artifact = {
         "sf": SF, "queries_ok": n_ok, "queries_total": len(QUERIES),
         "gen_s": round(gen_s, 1), "load_engine_s": round(load_engine_s, 1),
         "load_oracle_s": round(load_oracle_s, 1),
-        "host": {"nproc": os.cpu_count(),
-                 "platform": "cpu (no TPU this window — see TPU_PROBE log)"},
+        "host": {"nproc": os.cpu_count()},
+        "backend": {**resolve_backend(), "tpu_probe": last_tpu_probe()},
         "results": results,
         # bench artifacts and the metrics plane share one schema
         "sysstat": qmetrics.sysstat_dict(),
