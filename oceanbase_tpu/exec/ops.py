@@ -90,14 +90,25 @@ def limit(rel: Relation, k: int, offset: int = 0) -> Relation:
     return rel.with_mask(keep)
 
 
-def compact(rel: Relation, capacity: int | None = None) -> Relation:
+def compact(rel: Relation, capacity: int | None = None,
+            strict: bool = False) -> Relation:
     """Densify live rows to the front (stable).  Used before exchanges and
     as a cardinality-reduction point after selective filters/group-bys —
     the analog of the reference compacting batches when skip ratio is high
-    (ObBatchRows all_rows_active_)."""
+    (ObBatchRows all_rows_active_).
+
+    ``strict`` reports rows that do not fit ``capacity`` on the
+    ``compact_overflow`` diagnostic lane instead of silently truncating —
+    required wherever Compact feeds an aggregate (dropped rows there are
+    wrong answers, not wasted lanes) so the executor retries with scaled
+    budgets."""
     n = rel.capacity
     cap = capacity if capacity is not None else n
     m = rel.mask_or_true()
+    if strict and capacity is not None:
+        live_n = jnp.sum(m.astype(jnp.int64))
+        diag.push("compact_overflow", jnp.maximum(live_n - cap, 0),
+                  capacity=cap)
     order = jnp.argsort(~m, stable=True)  # live rows first, stable
     idx = order[:cap]
     live = jnp.take(m, idx)
@@ -748,6 +759,69 @@ def join(
                         mask=jnp.concatenate([live, app_live]))
 
     return Relation(columns=out_cols, mask=live)
+
+
+def index_probe(
+    probe: Relation,
+    sidecar: Relation,
+    base: Relation,
+    key: ir.Expr,
+    columns: Sequence[str] | None,
+    rename: dict[str, str] | None,
+    out_capacity: int | None = None,
+) -> Relation:
+    """Index nested-loop join: searchsorted probe of ``key`` into a
+    PRE-SORTED index sidecar, then a positional gather of the base
+    table's rows — the build-side argsort a hash join pays every
+    execution is amortized into the (cached, host-built) sidecar.
+
+    sidecar: ``__key__`` sorted int64 over the base's LIVE rows with
+    valid keys, padded with _INT_MAX; ``__pos__`` the matching row
+    positions into ``base``'s raw arrays.  Keys are exact ints (the
+    planner only picks this path for single int-like columns), so every
+    expanded lane is a true match — no verification pass.
+    NULL/dead probe keys never match (equi-join semantics).
+    """
+    ln = probe.capacity
+    lm = probe.mask_or_true()
+    kc = eval_expr(key, probe)
+    lkey = kc.data.astype(jnp.int64)
+    lvalid = _keys_valid([kc], lm)
+
+    skey = sidecar.columns["__key__"].data
+    spos = sidecar.columns["__pos__"].data
+    sn = sidecar.capacity
+
+    BIG = jnp.asarray(_INT_MAX, dtype=jnp.int64)
+    # BIG-1 (not BIG): the pad keys are BIG, so a dead probe lane's
+    # sentinel must sort strictly below them to report zero matches
+    lkey_p = jnp.where(lvalid, lkey, BIG - 1)
+    lo = jnp.searchsorted(skey, lkey_p, side="left")
+    hi = jnp.searchsorted(skey, lkey_p, side="right")
+    counts = jnp.where(lvalid, hi - lo, 0)
+
+    cap = out_capacity if out_capacity is not None else max(ln, sn)
+    total = jnp.sum(counts)
+    diag.push("index_probe_overflow", jnp.maximum(total - cap, 0),
+              capacity=cap)
+    start = jnp.cumsum(counts) - counts  # exclusive prefix
+    probe_idx = jnp.repeat(jnp.arange(ln), counts,
+                           total_repeat_length=cap)
+    out_live = jnp.arange(cap) < total
+    off = jnp.arange(cap) - jnp.take(start, probe_idx)
+    span = jnp.clip(jnp.take(lo, probe_idx) + off, 0, sn - 1)
+    base_idx = jnp.take(spos, span)
+
+    out_cols: dict[str, Column] = {}
+    for name, c in probe.columns.items():
+        out_cols[name] = c.gather(probe_idx)
+    names = columns if columns is not None else list(base.columns)
+    for bname in names:
+        g = base.columns[bname].gather(base_idx)
+        out_cols[(rename or {}).get(bname, bname)] = g
+    # every live lane is a real match: the sidecar holds only live rows
+    # with valid keys and int equality needs no verification
+    return Relation(columns=out_cols, mask=out_live)
 
 
 def semi_join_residual(

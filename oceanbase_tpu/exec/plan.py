@@ -262,6 +262,38 @@ class SemiJoinResidual(PlanNode):
 
 
 @dataclass(repr=True)
+class IndexProbe(PlanNode):
+    """Index nested-loop join: probe the child's key into a pre-sorted
+    index sidecar of ``table`` and gather the matched base rows
+    (≙ DAS index scan + table lookup, src/sql/das/iter — the NLJ access
+    path the CBO picks when the probe side is far under the base table).
+
+    The sidecar is a two-column relation (``__key__`` sorted int64,
+    ``__pos__`` row positions into the base snapshot) the session builds
+    host-side per data_version (sql/session.py::_prepare_index_probes)
+    and injects under ``sidecar_name()``.  Output = child columns
+    (expanded per match) + the base table's ``columns`` under
+    ``rename`` — exactly a HashJoin's output, minus the build-side
+    argsort every execution would pay."""
+
+    child: PlanNode
+    table: str
+    index: str
+    key: object          # ir.Expr over the child's columns
+    columns: Optional[list[str]] = None
+    rename: Optional[dict[str, str]] = None
+    out_capacity: Optional[int] = None
+    est_rows: Optional[int] = _est_field()
+
+    def children(self):
+        return (self.child,)
+
+    @staticmethod
+    def sidecar_name(table: str, index: str) -> str:
+        return f"__probe__{table}__{index}"
+
+
+@dataclass(repr=True)
 class Window(PlanNode):
     """Window functions: adds result columns (≙ the window-function op,
     src/sql/engine/window_function)."""
@@ -309,10 +341,15 @@ class Limit(PlanNode):
 
 @dataclass(repr=True)
 class Compact(PlanNode):
-    """Explicit cardinality-reduction point (densify live rows)."""
+    """Explicit cardinality-reduction point (densify live rows).
+
+    ``strict`` surfaces rows beyond ``capacity`` on the overflow lane
+    (executor retry) instead of silently truncating — mandatory when the
+    Compact feeds an aggregate."""
 
     child: PlanNode
     capacity: Optional[int] = None
+    strict: bool = False
     est_rows: Optional[int] = _est_field()
 
     def children(self):
@@ -526,6 +563,13 @@ def _lower_inner(node: PlanNode, tables: dict[str, Relation]) -> Relation:
             node.left_keys, node.right_keys, how=node.how,
             out_capacity=node.out_capacity,
         )
+    if isinstance(node, IndexProbe):
+        return ops.index_probe(
+            _lower(node.child, tables, node),
+            tables[IndexProbe.sidecar_name(node.table, node.index)],
+            tables[node.table], node.key, node.columns, node.rename,
+            out_capacity=node.out_capacity,
+        )
     if isinstance(node, SemiJoinResidual):
         return ops.semi_join_residual(
             _lower(node.left, tables, node),
@@ -557,7 +601,7 @@ def _lower_inner(node: PlanNode, tables: dict[str, Relation]) -> Relation:
                          node.offset)
     if isinstance(node, Compact):
         return ops.compact(_lower(node.child, tables, node),
-                           node.capacity)
+                           node.capacity, strict=node.strict)
     raise NotImplementedError(type(node).__name__)
 
 
@@ -565,9 +609,88 @@ def referenced_tables(node: PlanNode) -> set[str]:
     out = set()
     if isinstance(node, TableScan):
         out.add(node.table)
+    if isinstance(node, IndexProbe):
+        # the base table only: the sidecar is session-injected, not a
+        # catalog table the snapshot builder could resolve
+        out.add(node.table)
     for c in node.children():
         out |= referenced_tables(c)
     return out
+
+
+def prepare_index_probes(catalog, plan: PlanNode,
+                         tables: dict[str, Relation]) -> None:
+    """Host-build (and cache) the sorted index sidecar every IndexProbe
+    in ``plan`` reads, injecting it into ``tables`` in place: ``__key__``
+    the base table's index column over its LIVE valid rows, stably
+    sorted and padded to the bucket ladder with _INT_MAX; ``__pos__``
+    the matching positions into the base relation.  Cached on the
+    catalog keyed by the SOURCE Relation's identity (snapshot relations
+    are cached per version, so identity IS the data version; the entry
+    keeps the relation alive against id recycling) — the argsort a hash
+    join pays on every execution is paid here once per table version.
+
+    Every executor entry point that lowers a plan must call this (or
+    have its caller do so): session execution, bind-time scalar-subquery
+    folding, px fragment lowering."""
+    import numpy as np
+
+    from oceanbase_tpu.datatypes import SqlType
+    from oceanbase_tpu.exec.ops import _INT_MAX
+    from oceanbase_tpu.vector import Column, bucket_capacity
+
+    probes = []
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, IndexProbe):
+            probes.append(node)
+        stack.extend(node.children())
+    if not probes:
+        return
+    cache = getattr(catalog, "_probe_cache", None)
+    if cache is None:
+        cache = catalog._probe_cache = {}
+    for node in probes:
+        sname = IndexProbe.sidecar_name(node.table, node.index)
+        rel = tables.get(node.table)
+        if rel is None:
+            continue  # missing base table fails in _lower, not here
+        ckey = (node.table, node.index)
+        hit = cache.get(ckey)
+        if hit is not None and hit[0] == id(rel):
+            tables[sname] = hit[1]
+            continue
+        td = catalog.table_def(node.table)
+        ix = next(i for i in td.indexes if i.name == node.index)
+        base_col = ix.columns[0]
+        col = rel.columns[base_col]
+        kd = np.asarray(col.data).astype(np.int64)
+        valid = (np.ones(len(kd), dtype=bool) if col.valid is None
+                 else np.asarray(col.valid))
+        live = valid if rel.mask is None \
+            else (valid & np.asarray(rel.mask))
+        pos = np.nonzero(live)[0]
+        keys = kd[pos]
+        order = np.argsort(keys, kind="stable")
+        keys, pos = keys[order], pos[order]
+        n = len(keys)
+        cap = bucket_capacity(max(n, 1))
+        pk = np.full(cap, _INT_MAX, dtype=np.int64)
+        ppos = np.zeros(cap, dtype=np.int64)
+        pk[:n] = keys
+        ppos[:n] = pos
+        import jax.numpy as jnp
+
+        sidecar = Relation(
+            columns={
+                "__key__": Column(jnp.asarray(pk), None,
+                                  SqlType.int_()),
+                "__pos__": Column(jnp.asarray(ppos), None,
+                                  SqlType.int_())},
+            mask=None)
+        cache[ckey] = (id(rel), sidecar, rel)
+        tables[sname] = sidecar
 
 
 def _input_signature(tables: dict[str, Relation]) -> tuple:
@@ -880,6 +1003,16 @@ def execute_plan(plan: PlanNode, tables: dict[str, Relation],
     qadmission.checkpoint()
     key = plan.fingerprint()
     needed = referenced_tables(plan)
+    # IndexProbe sidecars are session-injected relations, not catalog
+    # tables — referenced_tables() deliberately omits them (its other
+    # callers resolve names against the catalog), so re-add them here
+    # or the filter below would strip the probe's sorted-key input
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, IndexProbe):
+            needed.add(IndexProbe.sidecar_name(n.table, n.index))
+        stack.extend(n.children())
     with_monitor = monitor_out is not None
     bundle = _compiled(key, _PlanHolder(plan, key), with_monitor)
     stats = bundle.stats

@@ -447,9 +447,15 @@ class NodeServer:
         from oceanbase_tpu.server import admission as qadmission
 
         rctx = None
+        pinned = ""
         if cancel_token:
-            ev = self.dtl_cancels.entry(str(cancel_token))
+            # pin for the fragment's whole execution: an LRU eviction
+            # while RUNNING would hand dtl.cancel a fresh Event the
+            # fragment's RemoteCtx never observes
+            ev = self.dtl_cancels.pin(str(cancel_token))
+            pinned = str(cancel_token)
             if ev.is_set():
+                self.dtl_cancels.unpin(pinned)
                 raise qadmission.QueryKilled(
                     f"fragment {cancel_token} cancelled before start")
             rctx = qadmission.RemoteCtx(ev, token=str(cancel_token))
@@ -461,16 +467,21 @@ class NodeServer:
         # must keep the statement's own ctx active — never mask it.
         import contextlib
 
-        with (qadmission.activate(rctx) if rctx is not None
-              else contextlib.nullcontext()):
-            with qtrace.span("dtl.fragment", table=table,
-                             part=int(part)) as sp:
-                out = dtl.execute_fragment(
-                    ts, plan, int(snapshot), int(part), int(nparts),
-                    with_ops=bool(with_ops),
-                    monitor_lanes=bool(monitor_lanes))
-                sp.tags.update(rows=out["rows"], scanned=out["scanned"])
-                return out
+        try:
+            with (qadmission.activate(rctx) if rctx is not None
+                  else contextlib.nullcontext()):
+                with qtrace.span("dtl.fragment", table=table,
+                                 part=int(part)) as sp:
+                    out = dtl.execute_fragment(
+                        ts, plan, int(snapshot), int(part), int(nparts),
+                        with_ops=bool(with_ops),
+                        monitor_lanes=bool(monitor_lanes))
+                    sp.tags.update(rows=out["rows"],
+                                   scanned=out["scanned"])
+                    return out
+        finally:
+            if pinned:
+                self.dtl_cancels.unpin(pinned)
 
     def _h_execute(self, sql: str, consistency: str = "strong",
                    session_id: int = 0, forwarded: bool = False):

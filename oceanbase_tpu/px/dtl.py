@@ -98,18 +98,57 @@ class CancelRegistry:
         self._lock = threading.Lock()
         self._entries: "collections.OrderedDict[str, threading.Event]" \
             = collections.OrderedDict()
+        #: token -> in-flight fragment count; pinned entries are never
+        #: LRU-evicted (evicting a live Event means dtl.cancel plants a
+        #: NEW one and the running fragment never observes KILL)
+        self._pins: dict[str, int] = {}
 
     def entry(self, token: str) -> threading.Event:
         """The cancel flag for ``token`` (created unset on first use)."""
         with self._lock:
             ev = self._entries.get(token)
             if ev is None:
-                while len(self._entries) >= self.MAX_ENTRIES:
-                    self._entries.popitem(last=False)
+                if len(self._entries) >= self.MAX_ENTRIES:
+                    self._evict_locked()
                 ev = self._entries[token] = threading.Event()
             else:
                 self._entries.move_to_end(token)
             return ev
+
+    def _evict_locked(self):
+        """Drop unpinned entries (tombstones / idle flags), oldest
+        first, until under capacity.  When every entry is pinned the map
+        grows past MAX_ENTRIES instead — correctness over the bound."""
+        excess = len(self._entries) - self.MAX_ENTRIES + 1
+        if excess <= 0:
+            return
+        for tok in [t for t in self._entries if t not in self._pins]:
+            del self._entries[tok]
+            excess -= 1
+            if excess <= 0:
+                break
+
+    def pin(self, token: str) -> threading.Event:
+        """Mark ``token``'s flag in-flight (re-entrant: one count per
+        executing fragment); the entry survives LRU until unpinned."""
+        with self._lock:
+            ev = self._entries.get(token)
+            if ev is None:
+                if len(self._entries) >= self.MAX_ENTRIES:
+                    self._evict_locked()
+                ev = self._entries[token] = threading.Event()
+            else:
+                self._entries.move_to_end(token)
+            self._pins[token] = self._pins.get(token, 0) + 1
+            return ev
+
+    def unpin(self, token: str):
+        with self._lock:
+            n = self._pins.get(token, 0) - 1
+            if n > 0:
+                self._pins[token] = n
+            else:
+                self._pins.pop(token, None)
 
     def cancel(self, token: str) -> bool:
         """Set the flag (planting it if unknown).  -> was it already
@@ -262,7 +301,7 @@ def encode_plan(node: pp.PlanNode):
                             for n, e in node.outputs.items()}}
     if isinstance(node, pp.Compact):
         return {"p": "compact", "child": encode_plan(node.child),
-                "cap": node.capacity}
+                "cap": node.capacity, "strict": node.strict}
     if isinstance(node, pp.GroupBy):
         return {"p": "groupby", "child": encode_plan(node.child),
                 "keys": {n: encode_expr(e) for n, e in node.keys.items()},
@@ -288,7 +327,8 @@ def decode_plan(d) -> pp.PlanNode:
                           {n: decode_expr(e)
                            for n, e in d["outputs"].items()})
     if k == "compact":
-        return pp.Compact(decode_plan(d["child"]), d.get("cap"))
+        return pp.Compact(decode_plan(d["child"]), d.get("cap"),
+                          strict=bool(d.get("strict", False)))
     if k == "groupby":
         return pp.GroupBy(decode_plan(d["child"]),
                           {n: decode_expr(e)

@@ -212,11 +212,15 @@ def _reps_match(ldts, rdts) -> bool:
 
 
 def choose_affinity(droot, tables):
-    """Pick ONE bottom-most scan-to-scan hash join and co-hash-shard its
-    two base tables on the join key, eliding both repartition exchanges
-    (≙ partition-wise join matching, src/sql/optimizer/ob_pwj_comparer.h
-    — here the 'matching partitioning' is CREATED at granule-assignment
-    time instead of discovered).
+    """Co-hash-shard EVERY qualifying scan-to-scan hash join on its join
+    key, eliding both repartition exchanges per join (≙ partition-wise
+    join matching, src/sql/optimizer/ob_pwj_comparer.h — here the
+    'matching partitioning' is CREATED at granule-assignment time
+    instead of discovered).  Joins are collected bottom-most-first; each
+    table co-shards for at most one join (scan_counts==1 already
+    guarantees a table appears under one scan, so later candidates
+    touching an already-claimed table are skipped rather than re-sharded
+    inconsistently).
 
     -> (affinity: {table: [key cols]}, elide: frozenset of join node
     ids) — empty when no join qualifies."""
@@ -258,10 +262,15 @@ def choose_affinity(droot, tables):
         found.append((node, lscan.table, lcols, rscan.table, rcols))
 
     visit(droot)
-    if not found:
-        return {}, frozenset()
-    node, lt, lc, rt, rc = found[0]  # bottom-most first (postorder)
-    return {lt: lc, rt: rc}, frozenset([id(node)])
+    affinity: dict = {}
+    elide: set = set()
+    for node, lt, lc, rt, rc in found:  # bottom-most first (postorder)
+        if lt in affinity or rt in affinity:
+            continue  # table already co-sharded for an earlier join
+        affinity[lt] = lc
+        affinity[rt] = rc
+        elide.add(id(node))
+    return affinity, frozenset(elide)
 
 
 # ---------------------------------------------------------------------------
@@ -296,7 +305,8 @@ def _dlower(node: pp.PlanNode, tables: dict, ndev: int, axis: str,
         return _copy_rep(ops.project(child, node.outputs), child)
     if isinstance(node, pp.Compact):
         child = _dlower(node.child, tables, ndev, axis, factor, elide)
-        return _copy_rep(ops.compact(child, node.capacity), child)
+        return _copy_rep(ops.compact(child, node.capacity,
+                                     strict=node.strict), child)
     if isinstance(node, pp.Union):
         kids = [_dlower(c, tables, ndev, axis, factor, elide)
                 for c in node.inputs]

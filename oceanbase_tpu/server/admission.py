@@ -173,6 +173,13 @@ class StmtCtx:
         self.checkpoints += 1
         if self.cancel.is_set():
             qmetrics.inc("admission.kills", tenant=self.tenant)
+            # the gv$tenant_resource lane counter too: a statement
+            # killed while RUNNING was invisible there (only the
+            # QUEUED path counted), so per-tenant kill accounting
+            # undercounted exactly the expensive victims
+            if self.controller is not None:
+                with self.controller._lock:
+                    self.controller._lane(self.tenant).kills += 1
             raise QueryKilled(
                 f"statement killed ({self.kill_reason}): "
                 f"session {self.session_id}")
@@ -181,6 +188,9 @@ class StmtCtx:
         now = time.monotonic()
         if self.deadline is not None and now > self.deadline:
             qmetrics.inc("admission.timeouts", tenant=self.tenant)
+            if self.controller is not None:
+                with self.controller._lock:
+                    self.controller._lane(self.tenant).timeouts += 1
             raise QueryTimeout(
                 f"query timeout after {now - self.started:.3f}s "
                 f"(session {self.session_id})")
@@ -666,6 +676,10 @@ class MemstoreThrottle:
         self._lock = threading.Lock()
         #: table -> {"bytes": int, "rows": int}
         self._tables: dict[str, dict] = {}
+        # running total of unflushed bytes, adjusted at every mutation
+        # (write/flush/drop): admit_write sits on EVERY row write's hot
+        # path, so it must not pay an O(n_tables) sum under the lock
+        self._used_bytes = 0
         self._flush_inflight = False
         self.throttle_sleeps = 0
         self.full_rejections = 0
@@ -695,7 +709,7 @@ class MemstoreThrottle:
 
     def used_bytes(self) -> int:
         with self._lock:
-            return sum(t["bytes"] for t in self._tables.values())
+            return self._used_bytes
 
     def admit_write(self, table: str, values: dict):
         """Gate + account one row write.  Raises MemstoreFull at the
@@ -707,7 +721,7 @@ class MemstoreThrottle:
         limit = self.limit_bytes()
         trigger = self.trigger_bytes()
         with self._lock:
-            used = sum(t["bytes"] for t in self._tables.values())
+            used = self._used_bytes
             # ONE accept/reject decision, made under the lock: a
             # rejected row is NEVER accounted (it never reaches the
             # memtable), and an accepted one must not be re-judged
@@ -722,6 +736,7 @@ class MemstoreThrottle:
                 ent["bytes"] += nbytes
                 ent["rows"] += 1
                 used += nbytes
+                self._used_bytes = used
                 self.peak_bytes = max(self.peak_bytes, used)
             fattest = self._fattest_locked()
             # take the one-shot flush token ONLY when it will actually
@@ -779,18 +794,21 @@ class MemstoreThrottle:
             # replayed writes) cannot push the estimate UP past what
             # was admitted — the hard limit must stay a hard limit
             ent["rows"] = max(int(remaining_rows), 0)
-            ent["bytes"] = min(int(ent["rows"] * avg), ent["bytes"])
+            shrunk = min(int(ent["rows"] * avg), ent["bytes"])
+            self._used_bytes -= ent["bytes"] - shrunk
+            ent["bytes"] = shrunk
 
     def drop_table(self, table: str):
         with self._lock:
-            self._tables.pop(table, None)
+            ent = self._tables.pop(table, None)
+            if ent is not None:
+                self._used_bytes -= ent["bytes"]
 
     def reset_peak(self):
         """Start a fresh peak-bytes window (benches measure a phase,
         not the process lifetime)."""
         with self._lock:
-            self.peak_bytes = sum(t["bytes"]
-                                  for t in self._tables.values())
+            self.peak_bytes = self._used_bytes
 
     def state(self) -> str:
         if not self.enabled():

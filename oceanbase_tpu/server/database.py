@@ -23,6 +23,7 @@ from typing import Optional
 from oceanbase_tpu.server.config import Config
 from oceanbase_tpu.server.monitor import (
     AshSampler,
+    PlanChoiceLedger,
     PlanFeedback,
     PlanHistory,
     PlanMonitor,
@@ -90,6 +91,10 @@ class Database:
         self.plan_feedback = PlanFeedback(
             int(self.config["plan_feedback_entries"]))
         self.plan_history = PlanHistory(
+            int(self.config["plan_history_entries"]))
+        # CBO self-validation ledger: bind-time predicted seconds vs the
+        # runner-up and the measured device seconds (gv$plan_choice)
+        self.plan_choice = PlanChoiceLedger(
             int(self.config["plan_history_entries"]))
         # roofline accounting per operator type + PROFILE capture store
         # (gv$time_calibration / gv$device_profile)

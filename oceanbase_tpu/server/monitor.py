@@ -413,6 +413,105 @@ class TimeCalibration:
             return out
 
 
+class PlanChoiceLedger:
+    """Every CBO plan choice, self-validated (gv$plan_choice).
+
+    ``record`` captures what the optimizer believed at bind time — the
+    chosen plan's predicted seconds, the runner-up's, the enumeration
+    method and the access paths taken; ``observe`` folds in what the
+    device actually measured for that logical plan.  The pair makes
+    cost-model lies visible per plan: ``pred_q`` is the usual max-ratio
+    q-error of pred_s vs device_s, and a choice whose margin over the
+    runner-up is smaller than its own q-error was effectively a coin
+    flip (the planqual bench's cost-model-validation lane aggregates
+    exactly this)."""
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = int(capacity)
+        #: lhash -> {"pred_s", "runner_up_s", "enumerated", "method",
+        #:           "n_rels", "index_probes", "binds", "executions",
+        #:           "device_s_sum", "pred_q", "last_ts"}
+        self._store: collections.OrderedDict[str, dict] = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def record(self, logical_hash: str, choices: list):
+        """Fold the binder's per-query-block choices for one statement
+        (outer block + subquery blocks); predicted seconds add up,
+        methods concatenate."""
+        if not logical_hash or not choices:
+            return
+        pred_s = sum(float(c.get("pred_s", 0.0)) for c in choices)
+        runner = sum(float(c.get("runner_up_s") or 0.0) for c in choices
+                     if c.get("runner_up_s") is not None)
+        enumerated = sum(int(c.get("enumerated", 0)) for c in choices)
+        probes = sum(int(c.get("index_probes", 0)) for c in choices)
+        methods = "+".join(sorted({str(c.get("method", "?"))
+                                   for c in choices}))
+        n_rels = max(int(c.get("n_rels", 1)) for c in choices)
+        with self._lock:
+            ent = self._store.get(logical_hash)
+            if ent is None:
+                while len(self._store) >= max(self.capacity, 1):
+                    self._store.popitem(last=False)
+                ent = self._store[logical_hash] = {
+                    "pred_s": 0.0, "runner_up_s": 0.0, "enumerated": 0,
+                    "method": "", "n_rels": 0, "index_probes": 0,
+                    "binds": 0, "executions": 0, "device_s_sum": 0.0,
+                    "pred_q": 0.0, "last_ts": 0.0}
+            else:
+                self._store.move_to_end(logical_hash)
+            ent["pred_s"] = pred_s
+            ent["runner_up_s"] = runner
+            ent["enumerated"] = enumerated
+            ent["method"] = methods
+            ent["n_rels"] = n_rels
+            ent["index_probes"] = probes
+            ent["binds"] += 1
+            ent["last_ts"] = time.time()
+
+    def observe(self, logical_hash: str, device_s: float):
+        """Measured device seconds for one execution of the chosen
+        plan; refreshes the validation q-error."""
+        if not logical_hash or device_s <= 0.0:
+            return
+        with self._lock:
+            ent = self._store.get(logical_hash)
+            if ent is None:
+                return  # choice evicted (or plan from a cold cache)
+            ent["executions"] += 1
+            ent["device_s_sum"] += float(device_s)
+            mean_dev = ent["device_s_sum"] / ent["executions"]
+            if ent["pred_s"] > 0.0 and mean_dev > 0.0:
+                ent["pred_q"] = max(ent["pred_s"] / mean_dev,
+                                    mean_dev / ent["pred_s"])
+
+    def rows(self) -> list:
+        with self._lock:
+            out = []
+            for lhash, ent in self._store.items():
+                mean_dev = (ent["device_s_sum"] / ent["executions"]
+                            if ent["executions"] else 0.0)
+                margin = (ent["runner_up_s"] / ent["pred_s"]
+                          if ent["pred_s"] > 0 and ent["runner_up_s"] > 0
+                          else 0.0)
+                out.append({
+                    "logical_hash": lhash,
+                    "pred_s": ent["pred_s"],
+                    "runner_up_s": ent["runner_up_s"],
+                    "margin": margin,
+                    "enumerated": ent["enumerated"],
+                    "method": ent["method"],
+                    "n_rels": ent["n_rels"],
+                    "index_probes": ent["index_probes"],
+                    "binds": ent["binds"],
+                    "executions": ent["executions"],
+                    "device_s_mean": mean_dev,
+                    "pred_q": ent["pred_q"],
+                    "last_ts": ent["last_ts"]})
+            return out
+
+
 class WaitEvents:
     """Named wait-event timers (≙ wait-event instrumentation).
 

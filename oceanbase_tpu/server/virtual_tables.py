@@ -36,6 +36,7 @@ class VirtualTables:
             "gv$sql_plan_monitor": self.plan_monitor,
             "gv$plan_feedback": self.plan_feedback,
             "gv$plan_history": self.plan_history,
+            "gv$plan_choice": self.plan_choice,
             "gv$plan_cache": self.plan_cache,
             "gv$cost_units": self.cost_units,
             "gv$time_calibration": self.time_calibration,
@@ -313,6 +314,36 @@ class VirtualTables:
             "regressed": np.array([bool(r["regressed"]) for r in rows]),
             "regress_count": np.array([r["regress_count"] for r in rows],
                                       np.int64),
+        }
+
+    def plan_choice(self):
+        """CBO self-validation ledger (server/monitor.py::
+        PlanChoiceLedger): per logical plan hash, the chosen plan's
+        predicted seconds vs the runner-up's, the enumeration method,
+        how many access paths were priced, and the prediction q-error
+        against the measured device seconds."""
+        pc = getattr(self.db, "plan_choice", None)
+        rows = pc.rows() if pc is not None else []
+        return {
+            "logical_hash": _obj(r["logical_hash"] for r in rows),
+            "pred_s": np.array([r["pred_s"] for r in rows], np.float64),
+            "runner_up_s": np.array([r["runner_up_s"] for r in rows],
+                                    np.float64),
+            "margin": np.array([r["margin"] for r in rows], np.float64),
+            "enumerated": np.array([r["enumerated"] for r in rows],
+                                   np.int64),
+            "method": _obj(r["method"] for r in rows),
+            "n_rels": np.array([r["n_rels"] for r in rows], np.int64),
+            "index_probes": np.array([r["index_probes"] for r in rows],
+                                     np.int64),
+            "binds": np.array([r["binds"] for r in rows], np.int64),
+            "executions": np.array([r["executions"] for r in rows],
+                                   np.int64),
+            "device_s_mean": np.array([r["device_s_mean"] for r in rows],
+                                      np.float64),
+            "pred_q": np.array([r["pred_q"] for r in rows], np.float64),
+            "last_ts": np.array([r["last_ts"] for r in rows],
+                                np.float64),
         }
 
     def plan_cache(self):

@@ -119,10 +119,31 @@ class Fragment:
 
 
 @dataclass
+class SemiEdge:
+    """A deferred semi/anti (EXISTS / IN / quantified) subquery edge.
+
+    The binder used to fuse these onto the home fragment immediately;
+    deferring the attachment lets the optimizer PLACE the semi join by
+    cost — on the home fragment (filter early) or above the whole join
+    tree, where the probe side has already been reduced by the other
+    joins (TPC-H Q21's equality expansion shrinks by the full join
+    selectivity up there)."""
+
+    home: int            # home fragment index in QueryBlock.fragments
+    plan: "pp.PlanNode"  # bound inner (build-side) plan
+    lhs: list            # probe-side key exprs (home fragment colids)
+    rkeys: list          # build-side key exprs (inner plan colids)
+    residual: list       # non-equality correlated predicates
+    anti: bool
+    build_est: int       # inner plan's cardinality estimate
+
+
+@dataclass
 class QueryBlock:
     fragments: list = field(default_factory=list)
     join_edges: list = field(default_factory=list)   # (fi, fj, lexpr, rexpr)
     post_preds: list = field(default_factory=list)   # applied after joins
+    semi_edges: list = field(default_factory=list)   # list[SemiEdge]
     # set by finishing phases:
     output: list = field(default_factory=list)       # [(colid, out_name)]
     est_rows: int = 0
@@ -141,6 +162,12 @@ class Binder:
         # (nextval, eagerly-executed scalar subqueries): such plans must
         # never be cached — re-binding is what re-evaluates them
         self.folded_volatile = False
+        # cost model for build_join_tree (None -> optimizer default);
+        # the session injects its calibrated units + corrections here
+        self.cost_model = None
+        # per-block CBO choice records (chosen pred_s vs runner-up) —
+        # the session feeds these into the gv$plan_choice ledger
+        self.cbo_choices: list = []
         # cycle guards: CTE / view names currently being expanded
         self._cte_stack: set[str] = set()
         self._view_stack: set[str] = set()
@@ -229,7 +256,10 @@ class Binder:
         # assemble join tree (order optimization + capacities in optimizer)
         from oceanbase_tpu.sql.optimizer import build_join_tree
 
-        plan, est, colid_frag = build_join_tree(qb, self.catalog)
+        plan, est, colid_frag = build_join_tree(qb, self.catalog,
+                                                cost=self.cost_model)
+        if getattr(qb, "cbo_choice", None):
+            self.cbo_choices.append(qb.cbo_choice)
 
         # residual predicates after joins
         for pred in qb.post_preds:
@@ -344,10 +374,12 @@ class Binder:
         if isinstance(e, ast.Subquery) and e.kind == "scalar":
             self.folded_volatile = True  # value depends on current data
             plan, outs, _ = self.bind_select(e.select)
-            from oceanbase_tpu.exec.plan import execute_plan, referenced_tables
+            from oceanbase_tpu.exec.plan import (
+                execute_plan, prepare_index_probes, referenced_tables)
 
             tables = {t: self.catalog.table_data(t)
                       for t in referenced_tables(plan)}
+            prepare_index_probes(self.catalog, plan, tables)
             rel = execute_plan(plan, tables)
             from oceanbase_tpu.vector import to_numpy
 
@@ -576,11 +608,13 @@ class Binder:
         """Bind one side of an eager (outer) join into a single fragment."""
         sub_qb = QueryBlock()
         self._bind_table_expr(tref, sub_qb, scope)
-        if len(sub_qb.fragments) == 1 and not sub_qb.post_preds:
+        if len(sub_qb.fragments) == 1 and not sub_qb.post_preds and \
+                not sub_qb.semi_edges:
             return sub_qb.fragments[0]
         from oceanbase_tpu.sql.optimizer import build_join_tree
 
-        plan, est, _ = build_join_tree(sub_qb, self.catalog)
+        plan, est, _ = build_join_tree(sub_qb, self.catalog,
+                                       cost=self.cost_model)
         for pred in sub_qb.post_preds:
             plan = pp.Filter(plan, pred)
             est = max(1, est // 3)
@@ -723,11 +757,13 @@ class Binder:
         raise BindError(f"unsupported subquery predicate {type(conj).__name__}")
 
     def _rewrite_semi(self, sub: ast.Subquery, qb, scope, anti: bool):
-        """EXISTS / IN / quantified -> semi or anti join on the home fragment."""
+        """EXISTS / IN / quantified -> a deferred SemiEdge on the home
+        fragment; the optimizer attaches it (fragment vs above the join
+        tree) by cost at build_join_tree time."""
         inner = sub.select
         corr = _CorrelationCollector(self, scope)
         in_plan, eq_outer, eq_inner_cids, residual, in_outs, in_est = \
-            corr.bind_inner(inner)
+            corr.bind_inner(inner, outer_qb=qb)
 
         lhs_exprs = []
         rhs_cids = []
@@ -751,34 +787,18 @@ class Binder:
         if len(homes) != 1:
             raise BindError("correlated subquery spans multiple tables "
                             "(unsupported in round 1)")
-        i = homes[0]
-        f = qb.fragments[i]
-        how = "anti" if anti else "semi"
-        cap = _pow2(int(f.est_rows * 2) + 16)
         rkeys = [ir.col(c) for c in rhs_cids]
-        est = max(1, f.est_rows // (2 if not anti else 3))
-        if residual:
-            new_plan = pp.SemiJoinResidual(
-                f.plan, in_plan, lhs_exprs, rkeys, residual,
-                anti=anti, out_capacity=cap, est_rows=est,
-            )
-        else:
-            # explicit capacity: inexact (multi-key) semi/anti joins expand
-            # candidate pairs for collision verification, and only a
-            # non-None out_capacity is reachable by scale_capacities on
-            # CapacityOverflow retries
-            new_plan = pp.HashJoin(f.plan, in_plan, lhs_exprs, rkeys,
-                                   how=how, out_capacity=cap, est_rows=est)
-        qb.fragments[i] = Fragment(new_plan, f.cols, est, f.unique_cols,
-                                   colids=f.colids, ndv=f.ndv,
-                                   hist=f.hist, mcv=f.mcv)
+        qb.semi_edges.append(SemiEdge(
+            home=homes[0], plan=in_plan, lhs=lhs_exprs, rkeys=rkeys,
+            residual=list(residual), anti=anti,
+            build_est=max(int(in_est), 1)))
 
     def _rewrite_scalar_cmp(self, conj, sub, other_side, sub_on_left, qb,
                             scope):
         inner = sub.select
         corr = _CorrelationCollector(self, scope)
         in_plan, eq_outer, eq_inner_cids, residual, in_outs, in_est = \
-            corr.bind_inner(inner)
+            corr.bind_inner(inner, outer_qb=qb)
         if residual:
             raise BindError("non-equality correlation in scalar subquery")
         val_cid = in_outs[0][0]
@@ -970,7 +990,7 @@ class _CorrelationCollector:
         self.binder = binder
         self.outer = outer_scope
 
-    def bind_inner(self, inner: ast.SelectStmt):
+    def bind_inner(self, inner: ast.SelectStmt, outer_qb=None):
         b = self.binder
         qb = QueryBlock()
         scope = Scope(parent=self.outer)
@@ -1015,7 +1035,10 @@ class _CorrelationCollector:
 
         from oceanbase_tpu.sql.optimizer import build_join_tree
 
-        plan, est, _ = build_join_tree(qb, b.catalog)
+        plan, est, _ = build_join_tree(qb, b.catalog,
+                                       cost=b.cost_model)
+        if getattr(qb, "cbo_choice", None):
+            b.cbo_choices.append(qb.cbo_choice)
         # predicates nested rewrites parked on the block (a correlated
         # scalar comparison becomes a post-join filter) MUST apply here —
         # dropping them silently widens the subquery (TPC-H Q20's
@@ -1070,6 +1093,8 @@ class _CorrelationCollector:
                 return _map_children(x, replace)
 
             new_items = [(replace(bound), name) for bound, name in items]
+            plan, est = self._seed_magic_set(
+                plan, est, eq_outer, eq_inner, qb, outer_qb, b)
             if key_map:
                 cap = _pow2(max(64, min(est, 1 << 22)))
                 plan = pp.GroupBy(plan, key_map, agg_specs, out_capacity=cap,
@@ -1094,6 +1119,7 @@ class _CorrelationCollector:
         # non-aggregate subquery (EXISTS / IN): project value + join cols
         outs = []
         proj = {}
+        _ = outer_qb  # magic-set seeding applies to the aggregate path
         for bound, name in items:
             cid = fresh("so")
             proj[cid] = bound
@@ -1110,6 +1136,60 @@ class _CorrelationCollector:
                     proj.setdefault(nn.name, ir.col(nn.name))
         plan = pp.Project(plan, proj)
         return plan, eq_outer, eq_inner_cids, residual, outs, est
+
+    @staticmethod
+    def _seed_magic_set(plan, est, eq_outer, eq_inner, qb, outer_qb, b):
+        """Seed a decorrelated aggregate with the outer key domain.
+
+        q17/q20-style correlated aggregates re-scan the whole inner
+        table and group it over EVERY key, even though the outer block
+        only probes a handful of them.  When the outer home fragment is
+        selective, semi-join the inner rows against it BEFORE grouping
+        (exact single-key semi joins are mask-only, so this costs two
+        searchsorteds), then compact so the GroupBy hashes thousands of
+        rows instead of millions.  The outer fragment snapshot here may
+        miss later-bound filters, which only widens the kept key set —
+        a superset seed is always sound for both semi and anti
+        consumers.
+        """
+        if (outer_qb is None or len(eq_inner) != 1 or len(eq_outer) != 1
+                or not getattr(outer_qb, "fragments", None)):
+            return plan, est
+        oused = {n.name for n in ir.walk(eq_outer[0])
+                 if isinstance(n, ir.ColumnRef)}
+        if not oused:
+            return plan, est
+        homes = [f for f in outer_qb.fragments if oused <= f.colids]
+        if len(homes) != 1:
+            return plan, est
+        fo = homes[0]
+        if fo.est_rows * 4 > est:
+            return plan, est  # outer side not selective: seeding buys nothing
+        key_ndv = 0
+        ik = eq_inner[0]
+        if isinstance(ik, ir.ColumnRef):
+            for f in qb.fragments:
+                if ik.name in f.ndv:
+                    key_ndv = int(f.ndv[ik.name])
+                    break
+        if key_ndv > 0:
+            matched = max(1, int(est) * max(int(fo.est_rows), 1)
+                          // max(key_ndv, 1))
+        else:
+            matched = max(int(fo.est_rows) * 4, 1024)
+        matched = min(matched, int(est))
+        # exact int-key semi joins take the mask-only fast path; the
+        # capacity only backs the inexact-key verification expansion and
+        # the retry ladder can still scale it on overflow
+        plan = pp.HashJoin(plan, fo.plan, [ik], [eq_outer[0]],
+                           how="semi",
+                           out_capacity=_pow2(int(est) * 2 + 16),
+                           est_rows=matched)
+        # strict: silent truncation here would DROP inner rows and yield
+        # wrong aggregates — overflow must surface and trigger a retry
+        plan = pp.Compact(plan, capacity=_pow2(matched * 4 + 1024),
+                          strict=True, est_rows=matched)
+        return plan, matched
 
 
 # ---------------------------------------------------------------------------

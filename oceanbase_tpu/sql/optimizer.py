@@ -1,29 +1,63 @@
-"""Join-order optimizer: DP enumeration with a greedy fallback.
+"""Cost-based join optimizer: plans priced in predicted SECONDS.
 
 Reference analog: the CBO join-order enumeration (src/sql/optimizer —
 ObJoinOrder with DP/IDP enumeration, ob_join_order_enum_idp.cpp) and the
-cost model (ObOptEstCost).  Left-deep Selinger DP over the equi-join
-graph for <= DP_MAX_RELS relations (TPC-H tops out at 8), minimizing the
-sum of intermediate cardinalities with NDV/PK-aware join estimates;
-beyond that, greedy by smallest estimated OUTPUT (not input — joining a
-low-NDV edge early can be catastrophically worse than a bigger PK join,
-see TPC-H Q5).
+cost model (ObOptEstCost).  Three layers replace the old left-deep,
+cardinality-only DP:
 
-Static capacities (the TPU twist): every join gets an out_capacity budget
-derived from the cardinality estimate; underestimates surface as
-CapacityOverflow at runtime and the session retries with a larger budget
-(≙ the reference spilling to disk where we re-plan, SURVEY §7 hard (a)).
-Capacities clamp at CAP_MAX: a bigger buffer could never materialize —
-the overflow routes to the disk-spill tier instead of an int32 crash.
+1. **Cost model in seconds** (``CostModel``): every candidate operator
+   is priced as ``predict_seconds(gv$cost_units, flops, bytes)`` —
+   the calibrated roofline from server/calibrate.py — scaled by the
+   per-operator-type correction factor ``gv$time_calibration`` has
+   measured (dev_s_sum / pred_s_sum).  Without a calibration probe the
+   model falls back to conservative CPU constants, so ranking still
+   reflects the real asymmetries (a build-side sort is n·log n, a
+   probe is a searchsorted, an index probe skips the sort entirely).
+
+2. **Bushy DP / IDP enumeration** (``_dp_bushy`` / ``_idp_tree``):
+   subset DP over the equi-join graph up to DP_MAX_RELS relations
+   (TPC-H tops out at 8), bushy trees allowed; beyond that, IDP(k) —
+   greedy seed order, then windowed DP re-optimization collapsing each
+   window's best tree into a composite vertex (≙ the reference's
+   iterative dynamic programming).  Join output estimates are NDV-based
+   with the PK-side rule applied as an UPPER BOUND, not a shortcut: a
+   filtered unique side keeps its filter selectivity (the old
+   ``return est`` ignored it — TPC-H Q17's 16M-row capacity cliff).
+
+3. **Access paths worth choosing between**: per join the model prices
+   (a) hash join probe→build, (b) hash join build→probe (orientation —
+   the build side pays the argsort), and (c) an index nested-loop
+   probe (exec/plan.py::IndexProbe) over a secondary index of the
+   build-side base table, when one exists on the join key.  Semi/anti
+   subquery edges (binder ``qb.semi_edges``) are PLACED by cost: on the
+   home fragment (filter early) or above the join tree (probe the
+   reduced intermediate) — TPC-H Q21's equality-expansion shrinks by
+   the full join selectivity in the latter spot.
+
+Static capacities (the TPU twist): every join gets an out_capacity
+budget derived from the cardinality estimate; underestimates surface as
+CapacityOverflow at runtime and the session retries with a larger
+budget (≙ the reference spilling to disk where we re-plan).  Capacities
+clamp at CAP_MAX: the overflow routes to the disk-spill tier instead of
+an int32 crash.  ``gv$plan_feedback`` corrections re-seed both the
+budgets and the estimate ledger at bind time (``apply_feedback``), so a
+misestimate observed once does not compound into the next plan.
 """
 
 from __future__ import annotations
+
+from dataclasses import dataclass, field
 
 from oceanbase_tpu.exec import plan as pp
 from oceanbase_tpu.expr import ir
 
 DP_MAX_RELS = 10
 CAP_MAX = 1 << 28  # rows; beyond this the spill tier is the answer
+
+# index nested-loop: only exact int-like single-column keys keep the
+# searchsorted probe collision-free (string/multi-key would need the
+# verification expansion a plain hash join already pays)
+_INL_MIN_SHRINK = 4  # probe side must be this much under the base rows
 
 
 def _pow2(n: int) -> int:
@@ -33,43 +67,493 @@ def _pow2(n: int) -> int:
     return min(p, CAP_MAX)
 
 
-def _join_out_est(est: int, tree_ndv: dict, f, keys) -> int:
-    """|T ⋈ f| estimate: PK join keeps the probe side; otherwise the
-    classic |L|·|R| / max(ndv(k)) with NDV from ANALYZE stats
-    (≙ ObOptEstCost join selectivity)."""
-    rkeys = [k[1] for k in keys]
-    rkey_cols = {k.name for k in rkeys if isinstance(k, ir.ColumnRef)}
-    if keys and rkey_cols & set(f.unique_cols):
-        return est
+# ---------------------------------------------------------------------------
+# cost model: operators priced in predicted seconds
+# ---------------------------------------------------------------------------
+
+
+def _default_units():
+    """Conservative single-core CPU constants used before any ALTER
+    SYSTEM CALIBRATE has populated gv$cost_units: the absolute seconds
+    are rough, but the RATIOS (sort vs probe vs gather) are what plan
+    ranking consumes."""
+    from oceanbase_tpu.server.calibrate import CostUnits
+
+    return CostUnits(backend="uncalibrated", peak_flops_s=2.0e9,
+                     peak_bytes_s=8.0e9, eff_bytes_s=4.0e9,
+                     launch_overhead_s=20e-6)
+
+
+def _log2(n: int) -> int:
+    return max(int(n), 2).bit_length()
+
+
+class CostModel:
+    """Prices candidate plan operators in predicted seconds.
+
+    ``units`` defaults to the process gv$cost_units payload
+    (server/calibrate.py::get_cost_units — populated by ALTER SYSTEM
+    CALIBRATE) or the uncalibrated fallback constants.  ``corrections``
+    maps operator-type name -> measured correction factor from
+    gv$time_calibration (dev_s_sum / pred_s_sum), so operator families
+    the roofline consistently misprices are re-anchored to measurement.
+    """
+
+    def __init__(self, units=None, corrections: dict | None = None):
+        if units is None:
+            from oceanbase_tpu.server import calibrate as qcal
+
+            units = qcal.get_cost_units() or _default_units()
+        self.units = units
+        self.corrections = dict(corrections or {})
+
+    def seconds(self, op: str, flops: float, nbytes: float,
+                calls: int = 1) -> float:
+        from oceanbase_tpu.server.calibrate import predict_seconds
+
+        s = predict_seconds(self.units, flops, nbytes, calls)
+        return s * float(self.corrections.get(op, 1.0))
+
+    # -- operator shapes (flops/bytes mirror exec/ops.py's kernels) ----
+    def hash_join_s(self, probe: int, build: int, out: int,
+                    ncols: int = 4) -> float:
+        """Sort-based equi-join: the build side pays an argsort
+        (n log n), the probe two searchsorteds (m log n), the output an
+        expansion gather per column."""
+        lb = _log2(build)
+        flops = 4.0 * build * lb + 2.0 * probe * lb + 2.0 * out
+        nbytes = 8.0 * (2.0 * build * lb / 4 + 2.0 * probe
+                        + out * max(ncols, 2))
+        return self.seconds("HashJoin", flops, nbytes)
+
+    def index_probe_s(self, probe: int, idx_rows: int, expand: int,
+                      ncols: int = 4) -> float:
+        """Index nested-loop: searchsorted into the PRE-SORTED index
+        sidecar (no build sort), then one gather per output column at
+        the matched base positions."""
+        flops = 2.0 * probe * _log2(idx_rows) + 2.0 * expand
+        nbytes = 8.0 * (2.0 * probe + expand * max(ncols, 2))
+        return self.seconds("IndexProbe", flops, nbytes)
+
+    def semi_s(self, probe: int, build: int, expand: int) -> float:
+        """Semi/anti join; ``expand`` is the equality-expansion lane
+        count (1:1 with probe for the exact-key fast path)."""
+        lb = _log2(build)
+        flops = 4.0 * build * lb + 2.0 * probe * lb + 4.0 * expand
+        nbytes = 8.0 * (2.0 * build + 2.0 * probe + 3.0 * expand)
+        return self.seconds("SemiJoinResidual" if expand > probe
+                            else "HashJoin", flops, nbytes)
+
+
+def default_cost_model() -> CostModel:
+    return CostModel()
+
+
+# ---------------------------------------------------------------------------
+# cardinality estimation
+# ---------------------------------------------------------------------------
+
+
+def _join_out_est(lest: int, lndv: dict, rest: int, rndv: dict,
+                  lunique, runique, keys) -> int:
+    """|L ⋈ R| estimate: the classic |L|·|R| / max(ndv(k)) with NDV
+    from ANALYZE stats (≙ ObOptEstCost join selectivity).  A unique
+    (PK) key side makes the probe side an UPPER BOUND — it must not
+    override the NDV estimate, which already carries the unique side's
+    filter selectivity (a 200-row filtered `part` joined to 6M
+    `lineitem` rows yields ~6k rows, not 6M — the old PK shortcut
+    returned the probe side whole and its capacity rode the plan)."""
     if not keys:
-        return min(est * max(f.est_rows, 1), 1 << 62)
+        return min(max(lest, 1) * max(rest, 1), 1 << 62)
     ndvs = []
     for lk, rk in keys:
-        if isinstance(lk, ir.ColumnRef) and lk.name in tree_ndv:
-            ndvs.append(tree_ndv[lk.name])
-        if isinstance(rk, ir.ColumnRef) and rk.name in f.ndv:
-            ndvs.append(f.ndv[rk.name])
+        if isinstance(lk, ir.ColumnRef) and lk.name in lndv:
+            ndvs.append(lndv[lk.name])
+        if isinstance(rk, ir.ColumnRef) and rk.name in rndv:
+            ndvs.append(rndv[rk.name])
+    lkey_cols = {k.name for k, _ in keys if isinstance(k, ir.ColumnRef)}
+    rkey_cols = {k.name for _, k in keys if isinstance(k, ir.ColumnRef)}
+    unique_hit = bool(rkey_cols & set(runique)) or \
+        bool(lkey_cols & set(lunique))
     if ndvs:
-        out = max(1, est * max(f.est_rows, 1) // max(ndvs))
-        # keep headroom: estimates are approximate
-        return max(out, est // 2, f.est_rows // 2)
-    return max(est * 2, f.est_rows)
+        out = max(1, lest * max(rest, 1) // max(ndvs))
+    elif unique_hit:
+        out = max(lest, rest)
+    else:
+        return max(lest * 2, rest)
+    if unique_hit:
+        # each probe row matches at most one build row (and vice versa
+        # on a both-unique join): cap at the smaller preserved side
+        bound = lest if rkey_cols & set(runique) else rest
+        return max(1, min(out, bound))
+    # keep headroom: non-unique estimates are approximate
+    return max(out, lest // 2, rest // 2)
 
 
-def build_join_tree(qb, catalog, capacity_factor: float = 1.5):
-    """qb: QueryBlock with fragments + join_edges.
-    -> (plan, est_rows, colid->fragment map)."""
+def _edge_keys(edges, left_members, right_members):
+    """All equi-join key pairs between two member sets, left-oriented."""
+    keys = []
+    for i in left_members:
+        for j in right_members:
+            for le, re_ in edges[i].get(j, []):
+                keys.append((le, re_))
+    return keys
+
+
+# ---------------------------------------------------------------------------
+# enumeration: bushy DP + IDP windowing + greedy fallback
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Item:
+    """One enumeration vertex: a base fragment or a collapsed subtree."""
+
+    tree: object            # frag index, or ("join", litem, ritem, swap)
+    members: frozenset      # frag indices covered
+    est: int
+    ndv: dict
+    unique: frozenset
+    ncols: int
+    cost_s: float = 0.0
+
+
+def _frag_item(i, f) -> _Item:
+    return _Item(tree=i, members=frozenset((i,)), est=max(f.est_rows, 1),
+                 ndv=dict(f.ndv), unique=frozenset(f.unique_cols),
+                 ncols=max(len(f.colids), 1))
+
+
+def _join_items(li: _Item, ri: _Item, edges, model: CostModel) -> _Item | None:
+    keys = _edge_keys(edges, li.members, ri.members)
+    if not keys:
+        return None
+    out = _join_out_est(li.est, li.ndv, ri.est, ri.ndv,
+                        li.unique, ri.unique, keys)
+    ncols = li.ncols + ri.ncols
+    # orientation: the build side pays the argsort — price both
+    fwd = model.hash_join_s(li.est, ri.est, out, ncols)
+    rev = model.hash_join_s(ri.est, li.est, out, ncols)
+    swap = rev < fwd
+    jc = rev if swap else fwd
+    ndv = dict(li.ndv)
+    ndv.update(ri.ndv)
+    return _Item(tree=("join", li, ri, swap),
+                 members=li.members | ri.members,
+                 est=max(out, 1), ndv=ndv,
+                 unique=li.unique | ri.unique, ncols=ncols,
+                 cost_s=li.cost_s + ri.cost_s + jc)
+
+
+def _dp_bushy(items: list, edges, model: CostModel):
+    """Subset DP over ``items`` (bushy trees, connected splits only).
+    -> (best _Item, runner_up_cost_s, states) or None when the join
+    graph is disconnected (cross joins route to the greedy path)."""
+    n = len(items)
+    full = (1 << n) - 1
+    dp: dict[int, _Item] = {1 << i: items[i] for i in range(n)}
+    root_second = None
+    for mask in range(1, full + 1):
+        if mask & (mask - 1) == 0 or mask in dp:
+            continue
+        best = None
+        second = None
+        sub = (mask - 1) & mask
+        while sub:
+            rest = mask ^ sub
+            if sub < rest:  # each split seen once; orientation is priced
+                li, ri = dp.get(sub), dp.get(rest)
+                if li is not None and ri is not None:
+                    cand = _join_items(li, ri, edges, model)
+                    if cand is not None:
+                        if best is None or cand.cost_s < best.cost_s:
+                            second = best.cost_s if best else second
+                            best = cand
+                        elif second is None or cand.cost_s < second:
+                            second = cand.cost_s
+            sub = (sub - 1) & mask
+        if best is not None:
+            dp[mask] = best
+            if mask == full:
+                root_second = second
+    hit = dp.get(full)
+    if hit is None:
+        return None
+    return hit, root_second, len(dp)
+
+
+def _greedy_item(items: list, edges, model: CostModel) -> _Item:
+    """Greedy fallback (cross joins / over-wide graphs): start at the
+    largest item, repeatedly fold in the edged candidate with the
+    cheapest resulting join; cross join only when nothing connects."""
+    remaining = list(items)
+    cur = max(remaining, key=lambda it: it.est)
+    remaining.remove(cur)
+    while remaining:
+        best, best_item = None, None
+        for it in remaining:
+            cand = _join_items(cur, it, edges, model)
+            if cand is not None and (best is None
+                                     or cand.cost_s < best.cost_s):
+                best, best_item = cand, it
+        if best is None:
+            # cross join: smallest first bounds the product
+            it = min(remaining, key=lambda x: x.est)
+            out = min(cur.est * max(it.est, 1), 1 << 62)
+            ndv = dict(cur.ndv)
+            ndv.update(it.ndv)
+            best = _Item(tree=("join", cur, it, False),
+                         members=cur.members | it.members,
+                         est=max(out, 1), ndv=ndv,
+                         unique=cur.unique | it.unique,
+                         ncols=cur.ncols + it.ncols,
+                         cost_s=cur.cost_s + it.cost_s
+                         + model.hash_join_s(cur.est, it.est, out,
+                                             cur.ncols + it.ncols))
+            best_item = it
+        cur = best
+        remaining.remove(best_item)
+    return cur
+
+
+def _idp_tree(items: list, edges, model: CostModel, k: int = DP_MAX_RELS):
+    """IDP(k): order items greedily, then repeatedly run the bushy DP
+    over a k-wide window and collapse its best tree into one composite
+    vertex (≙ ob_join_order_enum_idp.cpp's iterative DP past the full
+    enumeration width)."""
+    seed = _greedy_item(items, edges, model)
+
+    def order_of(it: _Item, acc):
+        if isinstance(it.tree, tuple):
+            _tag, li, ri, _swap = it.tree
+            order_of(li, acc)
+            order_of(ri, acc)
+        else:
+            acc.append(it)
+        return acc
+
+    ordered = order_of(seed, [])
+    work = list(ordered)
+    states = 0
+    while len(work) > 1:
+        window = work[: max(k, 2)]
+        rest = work[max(k, 2):]
+        hit = _dp_bushy(window, edges, model)
+        if hit is None:
+            collapsed = _greedy_item(window, edges, model)
+        else:
+            collapsed, _sec, st = hit
+            states += st
+        work = [collapsed] + rest
+    return work[0], None, states
+
+
+# ---------------------------------------------------------------------------
+# plan construction (access-path choice per join)
+# ---------------------------------------------------------------------------
+
+
+def _frag_scan_chain(plan):
+    """Filter*/Compact* chain over a TableScan -> (scan, [filter preds])
+    or None.  The preds re-apply above an index probe, so only plain
+    chains qualify (a Project would re-derive columns)."""
+    preds = []
+    node = plan
+    while isinstance(node, (pp.Filter, pp.Compact)):
+        if isinstance(node, pp.Filter):
+            preds.append(node.pred)
+        node = node.child
+    if isinstance(node, pp.TableScan):
+        return node, preds
+    return None
+
+
+def _index_for(catalog, table: str, base_col: str):
+    """Leading-column secondary index on ``table.base_col`` -> index
+    name, or None.  Only int-like columns qualify (the searchsorted
+    probe must be collision-free without a verification expansion)."""
+    try:
+        td = catalog.table_def(table)
+    except Exception:  # noqa: BLE001 — catalog-only relations
+        return None
+    if td is None:
+        return None
+    try:
+        kind = td.column(base_col).dtype.kind
+    except Exception:  # noqa: BLE001 — unknown column
+        return None
+    from oceanbase_tpu.datatypes import TypeKind
+
+    if kind not in (TypeKind.INT, TypeKind.DATE, TypeKind.DATETIME):
+        return None  # raw int64 comparison must be collision-free
+    for ix in getattr(td, "indexes", None) or []:
+        cols = list(getattr(ix, "columns", []) or [])
+        if cols and cols[0] == base_col:
+            return ix.name
+    return None
+
+
+def _inl_candidate(ri: _Item, frags, keys, catalog):
+    """Is the build side a single scan-chain fragment with a secondary
+    index on the (single) join key?  -> (frag, scan, preds, base_col,
+    index_name) or None."""
+    if len(ri.members) != 1 or len(keys) != 1:
+        return None
+    (idx,) = ri.members
+    f = frags[idx]
+    chain = _frag_scan_chain(f.plan)
+    if chain is None:
+        return None
+    scan, preds = chain
+    rk = keys[0][1]
+    if not isinstance(rk, ir.ColumnRef):
+        return None
+    inv = {cid: base for base, cid in (scan.rename or {}).items()}
+    base_col = inv.get(rk.name, rk.name)
+    iname = _index_for(catalog, scan.table, base_col)
+    if iname is None:
+        return None
+    return f, scan, preds, base_col, iname
+
+
+def _build_plan(item: _Item, frags, edges, model: CostModel, catalog,
+                capacity_factor: float, stats: dict):
+    """Recursively construct the physical plan for an enumeration item,
+    choosing the access path per join (hash fwd/rev vs index probe)."""
+    if not isinstance(item.tree, tuple):
+        return frags[item.tree].plan
+    _tag, li, ri, swap = item.tree
+    lplan = _build_plan(li, frags, edges, model, catalog,
+                        capacity_factor, stats)
+    rplan = _build_plan(ri, frags, edges, model, catalog,
+                        capacity_factor, stats)
+    keys = _edge_keys(edges, li.members, ri.members)
+    out_est = item.est
+    cap = _pow2(int(min(out_est, CAP_MAX) * capacity_factor) + 16)
+    ncols = item.ncols
+    hash_s = min(model.hash_join_s(li.est, ri.est, out_est, ncols),
+                 model.hash_join_s(ri.est, li.est, out_est, ncols))
+
+    # index nested-loop probe: build side is an indexed base table and
+    # the probe side is far under it — skip the scan-side sort wholly
+    for probe_i, build_i, probe_p, oriented in (
+            (li, ri, lplan, keys),
+            (ri, li, rplan, [(r, l) for l, r in keys])):
+        cand = _inl_candidate(build_i, frags, oriented, catalog)
+        if cand is None:
+            continue
+        f, scan, preds, base_col, iname = cand
+        base_rows = max(int(getattr(
+            catalog.table_def(scan.table), "row_count", 0) or 0),
+            f.est_rows, 1)
+        if probe_i.est * _INL_MIN_SHRINK > base_rows:
+            continue
+        key_ndv = max(f.ndv.get(oriented[0][1].name, base_rows), 1)
+        exp_est = max(1, probe_i.est * base_rows // key_ndv)
+        inl_s = model.index_probe_s(probe_i.est, base_rows, exp_est,
+                                    ncols)
+        if inl_s >= hash_s:
+            continue
+        stats["index_probes"] = stats.get("index_probes", 0) + 1
+        # enumeration priced this join as a hash join; the probe is
+        # cheaper by (hash_s - inl_s).  Accumulate so the ledger's
+        # pred_s reflects the plan actually emitted, and the all-hash
+        # variant of the same order becomes the runner-up.
+        stats["probe_saving_s"] = (stats.get("probe_saving_s", 0.0)
+                                   + (hash_s - inl_s))
+        icap = _pow2(int(min(exp_est, CAP_MAX) * capacity_factor) + 16)
+        node = pp.IndexProbe(
+            probe_p, table=scan.table, index=iname,
+            key=oriented[0][0], columns=scan.columns,
+            rename=scan.rename, out_capacity=icap, est_rows=exp_est)
+        # re-apply the chain's filter conjuncts above the probe
+        for pred in reversed(preds):
+            node = pp.Filter(node, pred, est_rows=max(1, out_est))
+        return node
+    if swap:
+        return pp.HashJoin(rplan, lplan, [k[1] for k in keys],
+                           [k[0] for k in keys], how="inner",
+                           out_capacity=cap, est_rows=max(1, out_est))
+    return pp.HashJoin(lplan, rplan, [k[0] for k in keys],
+                       [k[1] for k in keys], how="inner",
+                       out_capacity=cap, est_rows=max(1, out_est))
+
+
+# ---------------------------------------------------------------------------
+# semi/anti edge placement
+# ---------------------------------------------------------------------------
+
+
+def _semi_expansion(probe_est: int, build_est: int, key_ndv: int) -> int:
+    """Equality-expansion lane estimate for a residual semi join."""
+    return max(probe_est,
+               probe_est * max(build_est, 1) // max(key_ndv, 1))
+
+
+def _semi_key_ndv(e, ndv: dict, probe_est: int) -> int:
+    ndvs = [ndv[lk.name] for lk in e.lhs
+            if isinstance(lk, ir.ColumnRef) and lk.name in ndv]
+    return max(ndvs) if ndvs else max(probe_est, 1)
+
+
+def _attach_semi(plan, probe_est: int, e, key_ndv: int):
+    """Wrap ``plan`` with the semi/anti edge; -> (plan, est)."""
+    exp = _semi_expansion(probe_est, e.build_est, key_ndv)
+    cap = _pow2(int(min(exp, CAP_MAX) * 2) + 16)
+    est = max(1, probe_est // (3 if e.anti else 2))
+    if e.residual:
+        node = pp.SemiJoinResidual(plan, e.plan, list(e.lhs),
+                                   list(e.rkeys), list(e.residual),
+                                   anti=e.anti, out_capacity=cap,
+                                   est_rows=est)
+    else:
+        node = pp.HashJoin(plan, e.plan, list(e.lhs), list(e.rkeys),
+                           how="anti" if e.anti else "semi",
+                           out_capacity=cap, est_rows=est)
+    return node, est
+
+
+def _semi_cost(model: CostModel, probe_est: int, e, key_ndv: int) -> float:
+    exp = _semi_expansion(probe_est, e.build_est, key_ndv)
+    if not e.residual:
+        exp = probe_est  # exact-key fast path stays mask-only
+    return model.semi_s(probe_est, e.build_est, exp)
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def build_join_tree(qb, catalog, capacity_factor: float = 1.5,
+                    cost: CostModel | None = None):
+    """qb: QueryBlock with fragments + join_edges (+ semi_edges).
+    -> (plan, est_rows, colid->fragment map).  Side effect: sets
+    ``qb.cbo_choice`` with the chosen plan's predicted seconds, the
+    runner-up's, and the enumeration breadth (the gv$plan_choice
+    ledger's bind-time half)."""
     frags = list(qb.fragments)
     if not frags:
         raise ValueError("empty FROM")
+    model = cost or default_cost_model()
+    semi_edges = list(getattr(qb, "semi_edges", None) or [])
     n = len(frags)
     colid_frag = {}
     for i, f in enumerate(frags):
         for c in f.colids:
             colid_frag[c] = i
+
+    stats: dict = {}
     if n == 1:
         f = frags[0]
-        return f.plan, f.est_rows, {c: 0 for c in f.colids}
+        plan, est = f.plan, max(f.est_rows, 1)
+        for e in semi_edges:
+            key_ndv = _semi_key_ndv(e, f.ndv, est)
+            plan, est = _attach_semi(plan, est, e, key_ndv)
+        qb.cbo_choice = {"pred_s": 0.0, "runner_up_s": 0.0,
+                         "enumerated": 1, "method": "single",
+                         "n_rels": 1, "index_probes": 0}
+        return plan, est, {c: 0 for c in f.colids}
 
     # adjacency: edges[i][j] = list[(lexpr on i, rexpr on j)]
     edges: dict[int, dict[int, list]] = {i: {} for i in range(n)}
@@ -77,102 +561,75 @@ def build_join_tree(qb, catalog, capacity_factor: float = 1.5):
         edges[fi].setdefault(fj, []).append((le, re_))
         edges[fj].setdefault(fi, []).append((re_, le))
 
-    order = None
-    if n <= DP_MAX_RELS:
-        order = _dp_order(frags, edges, n)
-    if order is None:
-        order = _greedy_order(frags, edges, n)
+    # -- semi/anti placement: home fragment vs above the join tree ----
+    # a quick estimate-only greedy pass prices the "above the tree"
+    # probe side; each edge then takes the cheaper spot (TPC-H Q21's
+    # equality expansion shrinks by the join selectivity at the top)
+    top_semis = []
+    if semi_edges:
+        pre = _greedy_item([_frag_item(i, f) for i, f in
+                            enumerate(frags)], edges, model)
+        for e in semi_edges:
+            f = frags[e.home]
+            key_ndv = _semi_key_ndv(e, f.ndv, f.est_rows)
+            at_frag = _semi_cost(model, max(f.est_rows, 1), e, key_ndv)
+            at_top = _semi_cost(model, pre.est, e, key_ndv)
+            if at_top < at_frag:
+                top_semis.append(e)
+            else:
+                new_plan, new_est = _attach_semi(
+                    f.plan, max(f.est_rows, 1), e, key_ndv)
+                frags[e.home] = _clone_fragment(f, new_plan, new_est)
 
-    plan, est, tree_ndv = None, 0, {}
-    joined: set[int] = set()
-    for idx in order:
-        f = frags[idx]
-        if plan is None:
-            plan, est, tree_ndv = f.plan, f.est_rows, dict(f.ndv)
-            joined.add(idx)
-            continue
-        keys = _edge_keys(edges, joined, idx)
-        out_est = _join_out_est(est, tree_ndv, f, keys)
-        cap = _pow2(int(min(out_est, CAP_MAX) * capacity_factor) + 16)
-        plan = pp.HashJoin(plan, f.plan,
-                           [k[0] for k in keys], [k[1] for k in keys],
-                           how="inner", out_capacity=cap,
-                           est_rows=max(1, out_est))
-        est = max(1, out_est)
-        tree_ndv.update(f.ndv)
-        joined.add(idx)
+    items = [_frag_item(i, f) for i, f in enumerate(frags)]
+    method = "greedy"
+    runner_up = None
+    enumerated = n
+    best = None
+    if n <= DP_MAX_RELS:
+        hit = _dp_bushy(items, edges, model)
+        if hit is not None:
+            best, runner_up, enumerated = hit
+            method = "dp"
+    else:
+        best, runner_up, enumerated = _idp_tree(items, edges, model)
+        method = "idp"
+    if best is None:
+        best = _greedy_item(items, edges, model)
+    plan = _build_plan(best, frags, edges, model, catalog,
+                       capacity_factor, stats)
+    est = best.est
+    tree_ndv = best.ndv
+
+    for e in top_semis:
+        key_ndv = _semi_key_ndv(e, tree_ndv, est)
+        plan, est = _attach_semi(plan, est, e, key_ndv)
+
+    saving = stats.get("probe_saving_s", 0.0)
+    pred_s = max(best.cost_s - saving, 0.0)
+    # runner-up: the cheaper of the second-best join ORDER and (when an
+    # index probe won an access-path contest) the all-hash variant of
+    # the chosen order — both are real plans the optimizer rejected
+    alts = [c for c in (runner_up,) if c]
+    if saving > 0.0:
+        alts.append(best.cost_s)
+    qb.cbo_choice = {
+        "pred_s": round(pred_s, 9),
+        "runner_up_s": round(min(alts), 9) if alts else 0.0,
+        "enumerated": int(enumerated), "method": method,
+        "n_rels": n, "index_probes": int(stats.get("index_probes", 0))}
     return plan, est, colid_frag
 
 
-def _edge_keys(edges, joined: set, i: int):
-    keys = []
-    for j in joined:
-        for le, re_ in edges[j].get(i, []):
-            keys.append((le, re_))
-    return keys
+def _clone_fragment(f, plan, est):
+    import dataclasses
+
+    return dataclasses.replace(f, plan=plan, est_rows=max(1, est))
 
 
-def _greedy_order(frags, edges, n):
-    """Greedy: start at the largest (fact) table, then repeatedly join
-    the edged candidate with the smallest estimated OUTPUT."""
-    remaining = set(range(n))
-    start = max(remaining, key=lambda i: frags[i].est_rows)
-    order = [start]
-    joined = {start}
-    remaining.discard(start)
-    est = frags[start].est_rows
-    tree_ndv = dict(frags[start].ndv)
-    while remaining:
-        cands = [i for i in remaining if _edge_keys(edges, joined, i)]
-        if not cands:
-            cands = list(remaining)  # cross join fallback
-        scored = [(_join_out_est(est, tree_ndv, frags[i],
-                                 _edge_keys(edges, joined, i)), i)
-                  for i in cands]
-        out_est, nxt = min(scored)
-        order.append(nxt)
-        joined.add(nxt)
-        remaining.discard(nxt)
-        est = max(1, out_est)
-        tree_ndv.update(frags[nxt].ndv)
-    return order
-
-
-def _dp_order(frags, edges, n):
-    """Left-deep Selinger DP over connected extensions: dp[mask] = the
-    cheapest (sum of intermediate cardinalities) join order covering
-    ``mask``.  Returns None when the graph needs a cross join (the
-    greedy fallback handles those).
-
-    ≙ ob_join_order_enum_idp.cpp — full DP at this width; IDP's
-    windowed re-optimization only matters past DP_MAX_RELS, where the
-    greedy path takes over."""
-    full = (1 << n) - 1
-    # dp[mask] -> (cost, est, ndv, order)
-    dp: dict[int, tuple] = {}
-    for i in range(n):
-        dp[1 << i] = (0, frags[i].est_rows, dict(frags[i].ndv), (i,))
-    for mask in range(1, full + 1):
-        if mask not in dp or mask == full:
-            continue
-        cost, est, ndv, order = dp[mask]
-        joined = {i for i in range(n) if mask & (1 << i)}
-        for i in range(n):
-            if mask & (1 << i):
-                continue
-            keys = _edge_keys(edges, joined, i)
-            if not keys:
-                continue
-            out_est = _join_out_est(est, ndv, frags[i], keys)
-            ncost = cost + out_est
-            nmask = mask | (1 << i)
-            cur = dp.get(nmask)
-            if cur is None or ncost < cur[0]:
-                nndv = dict(ndv)
-                nndv.update(frags[i].ndv)
-                dp[nmask] = (ncost, max(1, out_est), nndv, order + (i,))
-    hit = dp.get(full)
-    return None if hit is None else list(hit[3])
+# ---------------------------------------------------------------------------
+# capacity evolution (retry ladder + feedback)
+# ---------------------------------------------------------------------------
 
 
 def scale_capacities(node: pp.PlanNode, factor: int) -> pp.PlanNode:
@@ -189,6 +646,8 @@ def scale_capacities(node: pp.PlanNode, factor: int) -> pp.PlanNode:
     updates = dict(kids)
     if hasattr(node, "out_capacity") and node.out_capacity is not None:
         updates["out_capacity"] = min(node.out_capacity * factor, CAP_MAX)
+    if getattr(node, "capacity", None) is not None:
+        updates["capacity"] = min(node.capacity * factor, CAP_MAX)
     if not updates:
         return node
     return dataclasses.replace(node, **updates)
@@ -215,7 +674,8 @@ def overflow_jump_factor(drops: list, slack: float = 1.5) -> int:
 
 def apply_feedback(plan: pp.PlanNode, corrections: dict,
                    slack: float = 1.5) -> tuple[pp.PlanNode, int]:
-    """Correct static budgets from observed cardinalities at bind time.
+    """Correct static budgets AND estimates from observed cardinalities
+    at bind time.
 
     ``corrections`` maps MONITORED-postorder position -> (op_name,
     observed_rows) from the gv$plan_feedback store (keyed by the plan's
@@ -223,9 +683,12 @@ def apply_feedback(plan: pp.PlanNode, corrections: dict,
     position space is exec/plan.py::monitored_postorder — pass-through
     operators emit no ledger row).  A node whose out_capacity is below
     the observed bucket starts at the bucket instead of re-riding the
-    CapacityOverflow retry ladder.  The op-name check guards against
-    postorder drift (e.g. the fused top-N path).
-    -> (plan, number of capacities raised)."""
+    CapacityOverflow retry ladder, and its ``est_rows`` is re-seeded to
+    the observation so every downstream consumer (spill candidates, px
+    budget snapping, the roofline's q-error ledger) prices against
+    measured reality instead of the compounding misestimate.  The
+    op-name check guards against postorder drift (e.g. the fused top-N
+    path).  -> (plan, number of capacities raised)."""
     import dataclasses
 
     from oceanbase_tpu.exec.plan import monitored_op
@@ -259,6 +722,7 @@ def apply_feedback(plan: pp.PlanNode, corrections: dict,
                 want = _pow2(int(rows * slack) + 16)
                 if want > node.out_capacity:
                     updates["out_capacity"] = min(want, CAP_MAX)
+                    updates["est_rows"] = int(rows)
                     n_fixed[0] += 1
         if not updates:
             return node

@@ -167,11 +167,15 @@ class Session:
 
     def _stmt_timeout_s(self) -> float | None:
         """Effective per-statement deadline: the session variable wins
-        (SET query_timeout_s = 0.5 works sub-second), else the config
-        default."""
+        (SET query_timeout_s = 0.5 works sub-second), then the tenant's
+        config overlay (SET GLOBAL writes there — reading db.config
+        directly would silently ignore it), else the cluster default."""
         v = self.variables.get("query_timeout_s")
-        if v is None and self.db is not None:
-            v = self.db.config["query_timeout_s"]
+        if v is None:
+            if self.tenant is not None:
+                v = self.tenant.config["query_timeout_s"]
+            elif self.db is not None:
+                v = self.db.config["query_timeout_s"]
         try:
             v = float(v)
         except (TypeError, ValueError):
@@ -992,11 +996,10 @@ class Session:
 
         plan, outputs, _est = self._plan_select(
             parse_sql(f"select * from {name}"), None)
-        rel = execute_plan(
-            plan,
-            {t: typed(t) for t in referenced_tables(plan)
-             if self.catalog.has_table(t)},
-            check_overflow=False)
+        dtables = {t: typed(t) for t in referenced_tables(plan)
+                   if self.catalog.has_table(t)}
+        self._prepare_index_probes(plan, dtables)
+        rel = execute_plan(plan, dtables, check_overflow=False)
         names, types = [], []
         for cid, oname in outputs:
             out_name, k = oname, 2
@@ -1151,11 +1154,35 @@ class Session:
         return result(rows)
 
     # ------------------------------------------------------------------
+    def _cost_model(self):
+        """CBO pricing context for this statement: THIS database's
+        measured gv$cost_units roofline (process fallback inside
+        CostModel when absent) with gv$time_calibration per-operator
+        corrections folded in — corrections are clamped and require a
+        few observations, so one wild early sample cannot poison every
+        later plan choice."""
+        from oceanbase_tpu.sql.optimizer import CostModel
+
+        units = (getattr(self.db, "cost_units", None)
+                 if self.db is not None else None)
+        corrections: dict = {}
+        tc = (getattr(self.db, "time_calibration", None)
+              if self.db is not None else None)
+        if tc is not None:
+            for r in tc.rows():
+                if r["count"] >= 3 and r["correction"] > 0.0:
+                    corrections[r["op"]] = min(
+                        max(float(r["correction"]), 0.25), 8.0)
+        return CostModel(units=units, corrections=corrections)
+
     def _plan_select(self, stmt: ast.SelectStmt, params):
         seqs = self.tenant.sequences if self.tenant is not None else None
         binder = Binder(self.catalog, params=params or [], sequences=seqs,
                         sysvars=self.variables)
-        return binder.bind_select(stmt)
+        binder.cost_model = self._cost_model()
+        out = binder.bind_select(stmt)
+        self._last_cbo_choices = list(binder.cbo_choices)
+        return out
 
     def _plan_select_cached(self, sql_key: str, stmt, params):
         """Plan-cache probe (≙ ObPlanCache::get_plan): bound plans keyed by
@@ -1168,12 +1195,17 @@ class Session:
         if hit is not None:
             self.plan_cache.move_to_end(key)  # LRU touch
             qmetrics.inc("plan_cache.hits")
+            # the gv$plan_choice row was recorded at the original bind;
+            # a cache hit only re-executes the already-chosen plan
+            self._last_cbo_choices = []
             return hit
         qmetrics.inc("plan_cache.misses")
         seqs = self.tenant.sequences if self.tenant is not None else None
         binder = Binder(self.catalog, params=params or [], sequences=seqs,
                         sysvars=self.variables)
+        binder.cost_model = self._cost_model()
         out = binder.bind_select(stmt)
+        self._last_cbo_choices = list(binder.cbo_choices)
         if not binder.folded_volatile:
             self._plan_cache_put(key, out)
         return out
@@ -1248,6 +1280,11 @@ class Session:
         # measured stats).  Keyed by the capacity-insensitive hash so the
         # corrected plan keeps matching its own history.
         lhash = _lhash_of(plan) if self.db is not None else ""
+        if lhash and getattr(self.db, "plan_choice", None) is not None \
+                and getattr(self, "_last_cbo_choices", None):
+            # bind-time CBO beliefs land in gv$plan_choice; the measured
+            # device seconds fold in below once the plan has run
+            self.db.plan_choice.record(lhash, self._last_cbo_choices)
         feedback_on = (
             self.db is not None
             and getattr(self.db, "plan_feedback", None) is not None
@@ -1281,6 +1318,7 @@ class Session:
                 self._try_ann_prefilter(plan, tables)
                 self._last_access_paths = self._index_prefilter(
                     plan, tables)
+                self._prepare_index_probes(plan, tables)
             return tables
 
         self._last_access_paths = {}
@@ -1407,6 +1445,10 @@ class Session:
                 logical_hash=lhash, retries=attempt, path=path,
                 host_s=times.host_s, device_s=times.device_s,
                 pred_s=pred_s, time_q=time_q)
+            if getattr(self.db, "plan_choice", None) is not None:
+                # validate the CHOICE, not just the plan: measured
+                # device seconds against the bind-time prediction
+                self.db.plan_choice.observe(lhash, times.device_s)
             if feedback_on and monitor and path == "serial":
                 # teach the feedback store from the serial ledger only:
                 # PX/DTL rows are positioned against rewritten plans, so
@@ -1577,6 +1619,14 @@ class Session:
         # recycled id would serve a stale index
         cache[key] = (ver, idx, rel)
         return idx
+
+    def _prepare_index_probes(self, plan, tables):
+        """Inject the sorted index sidecars every IndexProbe in the plan
+        reads (exec/plan.py::prepare_index_probes does the work; the
+        cache lives on the catalog keyed by source-relation identity)."""
+        from oceanbase_tpu.exec.plan import prepare_index_probes
+
+        prepare_index_probes(self.catalog, plan, tables)
 
     def _index_prefilter(self, plan, tables) -> dict:
         """Candidate-superset access paths (sql/access_path.py): replace
@@ -1798,6 +1848,9 @@ class Session:
                 device_tables[t] = self._table_snapshot(t)
         if not providers:
             return None
+        # device-resident (non-streamed) subtrees may carry IndexProbe
+        # nodes; their sorted sidecars ride in the device-table dict
+        self._prepare_index_probes(plan, device_tables)
         root = (self.db.root if self.db is not None and self.db.root
                 else None)
         sdir = os.path.join(root or "/tmp/obtpu", "tmpfile",
@@ -1989,6 +2042,7 @@ class Session:
                 tables = {t: self._table_snapshot(t)
                           for t in referenced_tables(plan)
                           if self.catalog.has_table(t)}
+                self._prepare_index_probes(plan, tables)
                 # ANALYZE always collects per-operator rows: the user
                 # asked for actuals, so the enable_sql_plan_monitor knob
                 # does not gate this statement's own collection
@@ -2179,13 +2233,25 @@ class Session:
                                         "name": stmt.name, "spec": spec})
             self.catalog.schema_version += 1
             return _ok()
-        if self.db is None:
-            raise NotImplementedError(
-                "CREATE INDEX needs the storage engine")
         if any(ix.name == stmt.name for ix in td.indexes):
             if stmt.if_not_exists:
                 return _ok()
             raise ValueError(f"index {stmt.name} exists on {stmt.table}")
+        if self.db is None:
+            # catalog-only: register metadata so the optimizer can
+            # choose the index-probe access path — the sorted sidecar
+            # builds lazily from the in-memory relation at execution
+            # (no engine index table to backfill)
+            from oceanbase_tpu.catalog import IndexDef
+
+            for c in stmt.columns:
+                td.column(c)  # existence check
+            td.indexes.append(IndexDef(
+                name=stmt.name, table=stmt.table,
+                columns=list(stmt.columns), unique=stmt.unique,
+                storage_table=""))
+            self.catalog.schema_version += 1
+            return _ok()
         if self._tx is not None and stmt.table in self._tx.participants:
             raise RuntimeError(
                 "CREATE INDEX on a table already written by the open "
@@ -2247,12 +2313,25 @@ class Session:
             self.catalog.schema_version += 1
             return _ok()
         if self.db is None:
-            raise NotImplementedError("DROP INDEX needs the storage engine")
+            # catalog-only metadata index (see _create_index)
+            before = len(td.indexes)
+            td.indexes = [ix for ix in td.indexes if ix.name != stmt.name]
+            if len(td.indexes) == before and not stmt.if_exists:
+                raise KeyError(
+                    f"index {stmt.name} not found on {stmt.table}")
+            cache = getattr(self.catalog, "_probe_cache", None)
+            if cache is not None:
+                cache.pop((stmt.table, stmt.name), None)
+            self.catalog.schema_version += 1
+            return _ok()
         try:
             self._engine.drop_index(stmt.table, stmt.name)
         except KeyError:
             if not stmt.if_exists:
                 raise
+        cache = getattr(self.catalog, "_probe_cache", None)
+        if cache is not None:
+            cache.pop((stmt.table, stmt.name), None)
         self.catalog.invalidate(stmt.table)
         self.catalog.schema_version += 1
         return _ok()

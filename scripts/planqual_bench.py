@@ -89,6 +89,13 @@ def bench_qerror_coverage() -> dict:
                 primary_key=TPCH_PRIMARY_KEYS[name])
         for name in tables:
             s.execute(f"analyze table {name}")
+        # the same *key secondary indexes sf_parity gives the oracle —
+        # without them the CBO has no index access paths to validate
+        for name, arrays in tables.items():
+            for c in arrays:
+                if c.endswith("key"):
+                    s.execute(
+                        f"create index idx_{name}_{c} on {name} ({c})")
         per_query = {}
         worst = {"q": None, "op": "", "q_error": 0.0}
         t0 = time.monotonic()
@@ -111,15 +118,57 @@ def bench_qerror_coverage() -> dict:
         run_s = time.monotonic() - t0
         all_covered = all(v["operators"] == v["with_qerror"]
                           for v in per_query.values())
+        cost_model = _cost_model_validation(db)
         db.close()
         return {"sf": SF, "gen_s": round(gen_s, 1),
                 "run_s": round(run_s, 1),
                 "queries": len(per_query),
                 "all_operators_covered": all_covered,
                 "worst_misestimate": worst,
+                "cost_model": cost_model,
                 "per_query": per_query}
     finally:
         shutil.rmtree(root, ignore_errors=True)
+
+
+def _cost_model_validation(db) -> dict:
+    """CBO validation over the ``gv$plan_choice`` ledger after a full
+    TPC-H run: how far the chosen plan's predicted seconds sat under
+    the runner-up's (margin), and whether predicted seconds RANK the
+    executed plans the same way measured device seconds do (pairwise
+    concordance) — the check that pricing in measured units actually
+    orders real plans, not just flop counts."""
+    rows = [r for r in db.plan_choice.rows() if r["executions"] > 0]
+    cbo = [r for r in rows if r["enumerated"] > 1 and r["pred_s"] > 0]
+    margins = sorted(r["margin"] for r in cbo if r["runner_up_s"] > 0)
+    pairs = conc = 0
+    ranked = [r for r in cbo if r["device_s_mean"] > 0]
+    for i in range(len(ranked)):
+        for j in range(i + 1, len(ranked)):
+            a, b = ranked[i], ranked[j]
+            if (a["pred_s"] == b["pred_s"]
+                    or a["device_s_mean"] == b["device_s_mean"]):
+                continue
+            pairs += 1
+            conc += int((a["pred_s"] > b["pred_s"])
+                        == (a["device_s_mean"] > b["device_s_mean"]))
+
+    def med(xs):
+        if not xs:
+            return None
+        k = len(xs) // 2
+        return xs[k] if len(xs) % 2 else (xs[k - 1] + xs[k]) / 2
+
+    mm = med(margins)
+    return {"plans_recorded": len(rows),
+            "plans_enumerated": len(cbo),
+            "plans_with_runner_up": len(margins),
+            "index_probe_plans":
+                sum(1 for r in rows if r["index_probes"] > 0),
+            "median_margin": round(mm, 3) if mm is not None else None,
+            "ranking_pairs": pairs,
+            "ranking_agreement":
+                round(conc / pairs, 3) if pairs else None}
 
 
 # ---------------------------------------------------------------------------
@@ -340,8 +389,18 @@ def main():
         result["skew"] = bench_skew()
 
     # contracts (the gate)
+    cm = cov["cost_model"]
     checks = {
         "qerror_all_operators": bool(cov["all_operators_covered"]),
+        # cost-model validation: the CBO must have priced real choices
+        # (enumerated plans with a runner-up) and predicted seconds must
+        # agree with measured device seconds on most plan-pair rankings
+        "cost_model_choices_recorded":
+            cm["plans_enumerated"] >= 5
+            and cm["plans_with_runner_up"] >= 1,
+        "cost_model_ranking":
+            cm["ranking_agreement"] is None
+            or cm["ranking_agreement"] >= 0.5,
         "overhead_le_2pct": ovh["overhead_pct"] <= 2.0,
         "feedback_one_retry":
             fb["on"]["first_run_retries"] == 1
