@@ -13,12 +13,14 @@ import ast
 import json
 import os
 import re
+import time
 from collections import Counter
 from dataclasses import dataclass
 from typing import Callable, Iterable, Sequence
 
 # rule families (each checker documents its rules under one family)
-FAMILIES = ("trace", "mask", "lock", "metric", "time")
+FAMILIES = ("trace", "mask", "lock", "metric", "time", "io", "cancel",
+            "rpc")
 
 _PRAGMA_RE = re.compile(r"#\s*obcheck:\s*ok\(([^)]*)\)")
 
@@ -177,25 +179,36 @@ BASELINE_PATH = os.path.join(
 
 def run_all(files: dict[str, str],
             checkers: Sequence[Callable[[Analyzer], list[Finding]]]
-            | None = None) -> list[Finding]:
+            | None = None,
+            timings: dict[str, float] | None = None) -> list[Finding]:
     """Run every checker over ``files``; pragma-suppressed findings are
-    already dropped.  Deterministic order (path, line, rule)."""
+    already dropped.  Deterministic order (path, line, rule).  When
+    ``timings`` is a dict, per-checker wall time accumulates into it
+    keyed by the checker's ``__name__``."""
     if checkers is None:
+        from oceanbase_tpu.analysis.cancel_rules import check_cancel_rules
+        from oceanbase_tpu.analysis.io_rules import check_io_rules
         from oceanbase_tpu.analysis.lock_order import check_lock_order
         from oceanbase_tpu.analysis.mask_discipline import (
             check_mask_discipline,
         )
         from oceanbase_tpu.analysis.metric_rules import check_metric_rules
+        from oceanbase_tpu.analysis.rpc_rules import check_rpc_rules
         from oceanbase_tpu.analysis.time_rules import check_time_rules
         from oceanbase_tpu.analysis.trace_safety import check_trace_safety
 
         checkers = (check_trace_safety, check_mask_discipline,
                     check_lock_order, check_metric_rules,
-                    check_time_rules)
+                    check_time_rules, check_io_rules, check_cancel_rules,
+                    check_rpc_rules)
     az = Analyzer(files)
     findings: list[Finding] = list(az.parse_errors)
     for chk in checkers:
+        t0 = time.monotonic()
         findings.extend(chk(az))
+        if timings is not None:
+            timings[chk.__name__] = (timings.get(chk.__name__, 0.0)
+                                     + time.monotonic() - t0)
     findings = az.filter(findings)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule,
                                            f.message))

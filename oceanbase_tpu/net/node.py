@@ -31,7 +31,9 @@ from oceanbase_tpu.net.health import HealthMonitor
 from oceanbase_tpu.net.rpc import RpcClient, RpcError, RpcServer
 from oceanbase_tpu.palf.cluster import NoQuorum, NotLeader
 from oceanbase_tpu.palf.netcluster import NetPalf
+from oceanbase_tpu.server import admission as qadmission
 from oceanbase_tpu.share.location import LocationCache
+from oceanbase_tpu.storage.integrity import CorruptionError, arrays_crc
 
 _DDL_KINDS = {"create_view", "drop_view",
               "create_table", "drop_table", "truncate", "alter_add",
@@ -382,12 +384,16 @@ class NodeServer:
         arrays, valids = ts.tablet.snapshot_arrays(snap)
         n = len(next(iter(arrays.values()))) if arrays else 0
         s, e = min(offset, n), min(offset + limit, n)
+        out_arrays = {k: np.asarray(v)[s:e] for k, v in arrays.items()}
+        out_valids = {k: np.asarray(v)[s:e]
+                      for k, v in valids.items() if v is not None}
         return {
             "snapshot": snap, "total": n,
-            "arrays": {k: np.asarray(v)[s:e]
-                       for k, v in arrays.items()},
-            "valids": {k: np.asarray(v)[s:e]
-                       for k, v in valids.items() if v is not None},
+            "arrays": out_arrays,
+            "valids": out_valids,
+            # per-chunk digest over the bytes that actually ship; the
+            # client verifies each page before concatenating
+            "crc": arrays_crc(out_arrays, out_valids),
             "types": {c.name: [c.dtype.kind.value, c.dtype.precision or 0,
                                c.dtype.scale or 0]
                       for c in ts.tdef.columns},
@@ -533,6 +539,10 @@ class NodeServer:
                 valids[name] = np.asarray(v)
         return {"names": list(res.names), "arrays": arrays,
                 "valids": valids, "rowcount": int(res.rowcount),
+                # result digest: forwarded statements ride the wire
+                # back, and the forwarding node verifies before handing
+                # rows to the session (local callers just ignore it)
+                "crc": arrays_crc(arrays, valids),
                 "types": {n: [t.kind.value, t.precision or 0,
                               t.scale or 0]
                           for n, t in res.dtypes.items()
@@ -544,6 +554,7 @@ class NodeServer:
         reachable (≙ OB_NOT_MASTER retry + failover)."""
         last_err: Exception | None = None
         for _attempt in range(4):
+            qadmission.checkpoint()  # KILL/deadline between route tries
             target = self.location.leader()
             if target is None or target == self.node_id:
                 try:
@@ -554,18 +565,41 @@ class NodeServer:
                     continue
                 return self._run_local(sql, session_id)
             try:
-                return self.peers[target].call(
+                # safe despite the retry loop: the request_sent guard
+                # below refuses to re-route once the statement may have
+                # reached the old leader's wire
+                res = self.peers[target].call(  # obcheck: ok(rpc.nonidempotent-resend)
                     "sql.execute", sql=sql, consistency=consistency,
                     session_id=(self.node_id << 32) | session_id,
                     forwarded=True)
+                self._verify_result(res, target)
+                return res
             except (OSError, RpcError) as e:
                 if isinstance(e, RpcError) and e.kind not in (
                         "NotLeader", "NoQuorum"):
+                    raise
+                if getattr(e, "request_sent", False):
+                    # the statement hit the wire and the reply was lost:
+                    # the DML may have applied on the old leader, so a
+                    # blind re-route could double-apply — surface the
+                    # transport error to the session layer instead
                     raise
                 last_err = e
                 self.location.invalidate()
                 time.sleep(0.25)
         raise NotLeader(f"no reachable leader: {last_err}")
+
+    def _verify_result(self, res: dict, peer: int):
+        """Digest check of a forwarded-statement reply (the sql twin of
+        dtl.verify_reply)."""
+        crc = res.get("crc")
+        if crc is None:
+            return  # pre-integrity peer build
+        got = arrays_crc(res.get("arrays", {}), res.get("valids", {}))
+        if got != crc:
+            raise CorruptionError(
+                f"sql.execute reply digest mismatch (peer {peer})",
+                kind="sql")
 
     # ------------------------------------------------------------------
     # remote-relation fetch (DAS client side)
@@ -591,9 +625,16 @@ class NodeServer:
         t0 = _time.time()       # record timestamp (wall)
         m0 = _time.monotonic()  # elapsed source (step-proof)
         while True:
+            qadmission.checkpoint()  # KILL/deadline between pages
             r, sent, recv = cli.call_with_size(
                 "das.scan", table=table, snapshot=snap,
                 offset=off, limit=SCAN_CHUNK_ROWS)
+            if r.get("crc") is not None and \
+                    arrays_crc(r["arrays"], r.get("valids", {})) \
+                    != r["crc"]:
+                raise CorruptionError(
+                    f"das.scan chunk digest mismatch (table {table}, "
+                    f"peer {node_id}, offset {off})", kind="das")
             nbytes += sent + recv
             snap = r["snapshot"]
             chunks.append(r)
@@ -751,7 +792,8 @@ def main(argv=None):
     print(f"node {args.node_id} listening on {args.host}:{node.port}",
           flush=True)
     try:
-        while True:
+        # CLI foreground idle: KeyboardInterrupt IS the cancel path
+        while True:  # obcheck: ok(cancel.loop-no-checkpoint)
             time.sleep(3600)
     except KeyboardInterrupt:
         node.stop()

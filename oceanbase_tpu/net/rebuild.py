@@ -46,6 +46,7 @@ import shutil
 import time
 
 from oceanbase_tpu.native import crc64
+from oceanbase_tpu.server import admission as qadmission
 from oceanbase_tpu.server import trace as qtrace
 from oceanbase_tpu.storage.integrity import CorruptionError
 
@@ -207,6 +208,7 @@ def _pick_source(peers: dict) -> tuple[int, object, dict] | None:
 
     best = None
     for pid, cli in sorted(peers.items()):
+        qadmission.checkpoint()  # KILL/deadline between peer probes
         try:
             st = cli.call("palf.state", _deadline_s=2.0)
         except (OSError, RpcError):
@@ -234,6 +236,7 @@ def fetch_file(cli, name: str, dst: str,
     with open(dst, "wb") as out:
         off = 0
         while True:
+            qadmission.checkpoint()  # KILL/deadline between chunks
             r = None
             for attempt in range(CHUNK_CRC_RETRIES):
                 r = cli.call("rebuild.fetch_segments", name=name,
@@ -280,6 +283,7 @@ def rebuild_from_peer(root: str, node_id: int, peers: dict,
         os.makedirs(tmp, exist_ok=True)
         nbytes = 0
         for f in meta["files"]:
+            qadmission.checkpoint()  # KILL/deadline between files
             dst = os.path.join(tmp, f["name"])
             nbytes += fetch_file(cli, f["name"], dst,
                                  chunk_bytes=int(chunk_bytes),

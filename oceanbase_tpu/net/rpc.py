@@ -545,15 +545,22 @@ class RpcClient:
                 may_retry = (not sent_ok) or pol.idempotent
                 if not may_retry or attempt >= max(pol.max_retries, 1):
                     self._count_terminal(method, e, now, deadline)
-                    raise self._at_deadline(e, method, now, deadline,
+                    err = self._at_deadline(e, method, now, deadline,
                                             deadline_s)
+                    # whether the request hit the wire before dying:
+                    # callers with their own retry ladders must not
+                    # resend a non-idempotent verb once this is True
+                    err.request_sent = sent_ok
+                    raise err
                 backoff = min(pol.backoff_base_s * (2 ** attempt),
                               pol.backoff_cap_s)
                 backoff *= 0.5 + random.random()  # full jitter
                 if now + backoff >= deadline:
                     self._count_terminal(method, e, now, deadline)
-                    raise self._at_deadline(e, method, now, deadline,
+                    err = self._at_deadline(e, method, now, deadline,
                                             deadline_s)
+                    err.request_sent = sent_ok
+                    raise err
                 time.sleep(backoff)
                 attempt += 1
                 qmetrics.inc("rpc.retries", verb=method)

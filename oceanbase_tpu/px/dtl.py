@@ -880,8 +880,10 @@ class DtlExchange:
             def _cancel_remote():
                 # best-effort, idempotent: stop in-flight remote
                 # fragments; a peer that already finished (or never
-                # got the fragment) just plants a tombstone
-                for _i, cli in remote:
+                # got the fragment) just plants a tombstone.  This IS
+                # the unwind path — it must run to completion even for
+                # a killed statement, bounded by dtl.cancel's 2s policy
+                for _i, cli in remote:  # obcheck: ok(cancel.loop-no-checkpoint)
                     try:
                         cli.call("dtl.cancel", token=cancel_token)
                     except Exception:  # noqa: BLE001 — unwinding

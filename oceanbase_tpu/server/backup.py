@@ -27,6 +27,7 @@ import os
 import shutil
 import time
 
+from oceanbase_tpu.server import admission as qadmission
 from oceanbase_tpu.storage.integrity import CorruptionError
 
 MANIFEST = "BACKUP_MANIFEST.json"
@@ -107,6 +108,7 @@ def incremental_backup(db, dest: str, base: str) -> str:
     os.makedirs(dest, exist_ok=False)
     copied, skipped = {}, 0
     for rel, size in _walk(db.root).items():
+        qadmission.checkpoint()  # KILL/deadline between file copies
         if rel == MANIFEST:
             continue
         src = os.path.join(db.root, rel)
@@ -136,6 +138,7 @@ def archive_wal(db, dest: str):
         with open(state_p) as fh:
             state = json.load(fh)
     for dirpath, _dirs, files in os.walk(db.root):
+        qadmission.checkpoint()  # KILL/deadline between directories
         for f in files:
             if not f.endswith(".log"):
                 continue
@@ -167,6 +170,7 @@ def restore_chain(backup: str, target: str) -> str:
     base = chain[-1]
     shutil.copytree(base, target, dirs_exist_ok=False)
     for inc in reversed(chain[:-1]):
+        qadmission.checkpoint()  # KILL/deadline between increments
         for dirpath, _dirs, files in os.walk(inc):
             for f in files:
                 if f == MANIFEST:
@@ -184,6 +188,7 @@ def overlay_archive(archive: str, target: str):
     """Lay archived WAL over a restored tree (archived logs are always
     at least as long as the backup's copies)."""
     for dirpath, _dirs, files in os.walk(archive):
+        qadmission.checkpoint()  # KILL/deadline between directories
         for f in files:
             if f == "ARCHIVE_STATE.json":
                 continue

@@ -44,7 +44,10 @@ def ensure_server_credentials(root: str) -> tuple[str, str]:
             .add_extension(x509.SubjectAlternativeName(
                 [x509.DNSName("localhost")]), critical=False)
             .sign(key, hashes.SHA256()))
-    with open(key_p, "wb") as fh:
+    # the unencrypted private key must never be world-readable, not
+    # even between create and a later chmod: open with 0o600 atomically
+    fd = os.open(key_p, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as fh:
         fh.write(key.private_bytes(
             serialization.Encoding.PEM,
             serialization.PrivateFormat.TraditionalOpenSSL,
@@ -72,8 +75,16 @@ def _openssl_credentials(tdir: str, cert_p: str, key_p: str
     # -addext needs OpenSSL >= 1.1.1; LibreSSL/older builds still make a
     # usable self-signed pair without the SAN
     for cmd in (base + ["-addext", "subjectAltName=DNS:localhost"], base):
-        r = subprocess.run(cmd, capture_output=True, text=True)
+        # umask guards the window while openssl holds the key file open
+        # (a post-hoc chmod would leave it world-readable mid-write)
+        old_umask = os.umask(0o177)
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True)
+        finally:
+            os.umask(old_umask)
         if r.returncode == 0:
+            os.chmod(key_p, 0o600)
+            os.chmod(cert_p, 0o644)  # certs are public
             return cert_p, key_p
     raise RuntimeError(
         f"openssl self-signed certificate generation failed: "

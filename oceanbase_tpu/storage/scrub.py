@@ -42,6 +42,7 @@ import threading
 import time
 from collections import deque
 
+from oceanbase_tpu.server import admission as qadmission
 from oceanbase_tpu.server import metrics as qmetrics
 from oceanbase_tpu.server import trace as qtrace
 from oceanbase_tpu.storage.integrity import CorruptionError, table_digest
@@ -236,6 +237,7 @@ class Scrubber:
                 need_repair.setdefault(name, "digest_minority")
             # ---- repair: quarantined / corrupt / minority tables
             for name, reason in sorted(need_repair.items()):
+                qadmission.checkpoint()  # KILL/deadline between repairs
                 ok = False
                 for _attempt in range(REPAIR_RETRIES):
                     if self._repair_table(name, reason):
@@ -285,6 +287,7 @@ class Scrubber:
         votes: dict[int, dict] = {node.node_id: local["tables"]}
         health = getattr(node, "health", None)
         for pid in sorted(peers):
+            qadmission.checkpoint()  # KILL/deadline between peer votes
             if health is not None and health.state(pid) != "up":
                 continue
             try:
@@ -379,6 +382,7 @@ class Scrubber:
         t0 = time.monotonic()
         last_err: Exception | None = None
         for pid in sorted(node.peers):
+            qadmission.checkpoint()  # KILL/deadline between candidates
             if health is not None and health.state(pid) != "up":
                 continue
             cli = node.peers[pid]

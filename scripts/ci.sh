@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Local CI gate, in the order review expects:
+#   1. obcheck --ci   static contract families (trace/mask/lock/metric/
+#                     time/io/cancel/rpc) vs analysis/baseline.json
+#   2. poison         dynamic Static-shape policy check: poison-lane
+#                     parity tests (analysis/poison.py via the fixture)
+#   3. tier-1         full non-slow pytest suite
+# Prints one PASS/FAIL line per stage and exits non-zero if any failed.
+set -u
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+names=()
+results=()
+overall=0
+
+run_stage() {
+    name="$1"; shift
+    echo "=== $name: $*"
+    if "$@"; then
+        names+=("$name"); results+=("PASS")
+    else
+        names+=("$name"); results+=("FAIL"); overall=1
+    fi
+    echo
+}
+
+run_stage "obcheck" python scripts/obcheck.py --ci
+run_stage "poison" python -m pytest tests/ -q -m "not slow" -k poison \
+    -p no:cacheprovider
+run_stage "tier-1" python -m pytest tests/ -q -m "not slow" \
+    -p no:cacheprovider
+
+echo "==== local CI summary ===="
+for i in "${!names[@]}"; do
+    printf '  %-8s %s\n' "${names[$i]}" "${results[$i]}"
+done
+if [ "$overall" -eq 0 ]; then
+    echo "RESULT: PASS"
+else
+    echo "RESULT: FAIL"
+fi
+exit "$overall"

@@ -45,6 +45,10 @@ from oceanbase_tpu.analysis import (  # noqa: E402
     run_all,
     write_baseline,
 )
+from oceanbase_tpu.analysis.cancel_rules import (  # noqa: E402
+    check_cancel_rules,
+)
+from oceanbase_tpu.analysis.io_rules import check_io_rules  # noqa: E402
 from oceanbase_tpu.analysis.lock_order import check_lock_order  # noqa: E402
 from oceanbase_tpu.analysis.mask_discipline import (  # noqa: E402
     check_mask_discipline,
@@ -52,6 +56,7 @@ from oceanbase_tpu.analysis.mask_discipline import (  # noqa: E402
 from oceanbase_tpu.analysis.metric_rules import (  # noqa: E402
     check_metric_rules,
 )
+from oceanbase_tpu.analysis.rpc_rules import check_rpc_rules  # noqa: E402
 from oceanbase_tpu.analysis.time_rules import check_time_rules  # noqa: E402
 from oceanbase_tpu.analysis.trace_safety import check_trace_safety  # noqa: E402
 
@@ -61,7 +66,14 @@ CHECKERS = {
     "lock": check_lock_order,
     "metric": check_metric_rules,
     "time": check_time_rules,
+    "io": check_io_rules,
+    "cancel": check_cancel_rules,
+    "rpc": check_rpc_rules,
 }
+
+
+def _matches(rule: str, prefix: str) -> bool:
+    return rule == prefix or rule.startswith(prefix + ".")
 
 
 def main(argv=None) -> int:
@@ -75,21 +87,37 @@ def main(argv=None) -> int:
     ap.add_argument("--root", default=REPO, help="repo root to scan")
     ap.add_argument("--baseline", default=core.BASELINE_PATH,
                     help="baseline file path")
-    ap.add_argument("--rules", default="trace,mask,lock,metric,time",
+    ap.add_argument("--rules",
+                    default="trace,mask,lock,metric,time,io,cancel,rpc",
                     help="comma-separated rule families to run")
+    ap.add_argument("--family", action="append", default=None,
+                    metavar="PREFIX",
+                    help="only run/report rules matching this prefix "
+                         "(repeatable; e.g. --family io --family "
+                         "rpc.missing-policy)")
     args = ap.parse_args(argv)
 
     t0 = time.monotonic()
     files = load_package_files(args.root)
     selected = [r.strip() for r in args.rules.split(",")
                 if r.strip() in CHECKERS]
-    if args.write_baseline and set(selected) != set(CHECKERS):
+    if args.family:
+        # a prefix selects its whole family to run, then narrows output
+        selected = [r for r in selected
+                    if any(_matches(p, r) or _matches(r, p)
+                           for p in args.family)]
+    if args.write_baseline and (set(selected) != set(CHECKERS)
+                                or args.family):
         # a partial run must never overwrite the other families' entries
         print("obcheck: --write-baseline requires all rule families "
-              "(drop --rules)", file=sys.stderr)
+              "(drop --rules/--family)", file=sys.stderr)
         return 2
     checkers = [CHECKERS[r] for r in selected]
-    findings = run_all(files, checkers)
+    timings: dict[str, float] = {}
+    findings = run_all(files, checkers, timings=timings)
+    if args.family:
+        findings = [f for f in findings
+                    if any(_matches(f.rule, p) for p in args.family)]
     baseline = load_baseline(args.baseline) if not args.write_baseline \
         else Counter()
     new = diff_findings(findings, baseline)
@@ -102,6 +130,8 @@ def main(argv=None) -> int:
 
     by_rule = Counter(f.rule for f in findings)
     if args.json:
+        family_s = {fam: round(timings.get(fn.__name__, 0.0), 3)
+                    for fam, fn in CHECKERS.items() if fam in selected}
         print(json.dumps({
             "metric": "obcheck",
             "files": len(files),
@@ -109,6 +139,7 @@ def main(argv=None) -> int:
             "new": len(new),
             "baselined": len(findings) - len(new),
             "by_rule": {k: by_rule[k] for k in sorted(by_rule)},
+            "family_s": family_s,
             "duration_s": round(time.monotonic() - t0, 3),
         }))
     if not args.json or new:

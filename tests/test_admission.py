@@ -711,64 +711,39 @@ def test_memstore_backpressure_sql(db):
 
 
 # ---------------------------------------------------------------------------
-# POLICIES completeness (satellite: no verb ships without an explicit
-# deadline/idempotence decision)
+# POLICIES completeness is now machine-enforced by obcheck's rpc.*
+# family (oceanbase_tpu/analysis/rpc_rules.py, run by scripts/ci.sh):
+# the old AST-scraping completeness tests here are retired in its
+# favor.  What stays is a seeded-violation proof that the enforcing
+# rule actually fires when a handler ships without a policy entry.
 # ---------------------------------------------------------------------------
 
 
-def test_every_registered_verb_has_explicit_policy():
-    """Every RPC verb registered by net/node.py (including the palf and
-    rebuild handler maps it splices in) must carry an explicit POLICIES
-    entry — POLICIES.get(method, DEFAULT_POLICY) must never be the
-    silent decision for a shipped verb."""
-    import ast as pyast
+def test_obcheck_catches_handler_without_policy():
+    """A verb registered in a handler map with no POLICIES entry must
+    surface as rpc.missing-policy — the rule that replaced the coarse
+    completeness assertions."""
+    from oceanbase_tpu.analysis.rpc_rules import check_rpc_rules
+    from oceanbase_tpu.analysis.core import run_all
 
-    from oceanbase_tpu.net.rpc import POLICIES
-
-    def dict_keys_of(path, within=None):
-        with open(path) as f:
-            tree = pyast.parse(f.read())
-        keys = set()
-        for node in pyast.walk(tree):
-            if isinstance(node, pyast.Dict):
-                for k in node.keys:
-                    if isinstance(k, pyast.Constant) and \
-                            isinstance(k.value, str):
-                        keys.add(k.value)
-        return keys
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    verbs = set()
-    for rel in ("oceanbase_tpu/net/node.py",
-                "oceanbase_tpu/palf/netcluster.py",
-                "oceanbase_tpu/net/rebuild.py"):
-        verbs |= {k for k in dict_keys_of(os.path.join(repo, rel))
-                  if ("." in k and k.replace(".", "").replace("_", "")
-                      .isalnum() and k.split(".")[0] in (
-                          "das", "dtl", "sql", "node", "cluster",
-                          "recovery", "metrics", "fault", "scrub",
-                          "rebuild", "palf")) or k == "ping"}
-    assert verbs, "verb extraction found nothing — test is broken"
-    missing = sorted(v for v in verbs if v not in POLICIES)
-    assert not missing, (
-        f"verbs with no explicit POLICIES entry: {missing} — add a "
-        f"VerbPolicy (non-idempotent => max_retries=0)")
-
-
-def test_every_live_handler_verb_has_policy(tmp_path):
-    """Belt over the AST suspenders: boot one in-process node and check
-    the REAL handler table against POLICIES."""
-    from oceanbase_tpu.net.node import NodeServer
-    from oceanbase_tpu.net.rpc import POLICIES
-
-    n = NodeServer(1, "127.0.0.1", 0, {}, root=str(tmp_path / "n1"))
-    n.start()  # stop() joins serve_forever — it must have started
-    try:
-        missing = sorted(v for v in n.server.handlers
-                         if v not in POLICIES)
-        assert not missing, missing
-    finally:
-        n.stop()
+    policy_src = (
+        "POLICIES: dict = {\n"
+        '    "node.state": VerbPolicy(2.0, True),\n'
+        "}\n")
+    handler_src = (
+        "class S:\n"
+        "    def handlers(self):\n"
+        "        return {\n"
+        '            "node.state": self._h_state,\n'
+        '            "node.rogue": self._h_rogue,\n'
+        "        }\n")
+    findings = run_all({"oceanbase_tpu/net/rpc.py": policy_src,
+                        "oceanbase_tpu/net/extra.py": handler_src},
+                       [check_rpc_rules])
+    rules = {(f.rule, f.path) for f in findings}
+    assert ("rpc.missing-policy", "oceanbase_tpu/net/extra.py") in rules
+    # the covered verb must NOT fire
+    assert not any("node.state" in f.message for f in findings)
 
 
 # ---------------------------------------------------------------------------

@@ -308,3 +308,34 @@ def test_tls_upgrade(server):
     c.query("insert into tt values (1), (2)")
     assert c.query("select count(*) from tt")["rows"] == [("2",)]
     c.close()
+
+
+def test_tls_key_file_mode(tmp_path):
+    """The generated private key must be owner-only (0o600) — a
+    world-readable key silently voids the TLS upgrade."""
+    import os
+    import stat
+
+    from oceanbase_tpu.server.tls import ensure_server_credentials
+
+    cert_p, key_p = ensure_server_credentials(str(tmp_path))
+    assert os.path.exists(cert_p)
+    assert stat.S_IMODE(os.stat(key_p).st_mode) == 0o600
+
+
+def test_tls_key_file_mode_openssl_fallback(tmp_path):
+    """Same 0o600 guarantee on the openssl-CLI fallback path."""
+    import os
+    import shutil
+    import stat
+
+    from oceanbase_tpu.server.tls import _openssl_credentials
+
+    if shutil.which("openssl") is None:
+        pytest.skip("no openssl binary on this host")
+    tdir = str(tmp_path / "tls")
+    os.makedirs(tdir)
+    cert_p = os.path.join(tdir, "server-cert.pem")
+    key_p = os.path.join(tdir, "server-key.pem")
+    _openssl_credentials(tdir, cert_p, key_p)
+    assert stat.S_IMODE(os.stat(key_p).st_mode) == 0o600

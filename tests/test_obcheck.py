@@ -24,8 +24,11 @@ from oceanbase_tpu.analysis import (
     run_all,
     write_baseline,
 )
+from oceanbase_tpu.analysis.cancel_rules import check_cancel_rules
+from oceanbase_tpu.analysis.io_rules import check_io_rules
 from oceanbase_tpu.analysis.lock_order import check_lock_order
 from oceanbase_tpu.analysis.mask_discipline import check_mask_discipline
+from oceanbase_tpu.analysis.rpc_rules import check_rpc_rules
 from oceanbase_tpu.analysis.trace_safety import check_trace_safety
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -316,6 +319,263 @@ def test_unlocked_mutation_detected_and_pragma():
 
 
 # ---------------------------------------------------------------------------
+# io discipline (durable binary writes carry checksums)
+# ---------------------------------------------------------------------------
+
+IO_BAD = '''
+def save_blob(path, payload):
+    with open(path, "wb") as f:
+        f.write(payload)
+'''
+
+IO_CLEAN = '''
+from oceanbase_tpu.native import crc64
+
+def save_blob(path, payload):
+    digest = crc64(payload)
+    with open(path, "wb") as f:
+        f.write(payload + digest.to_bytes(8, "little"))
+'''
+
+IO_CLEAN_TRANSITIVE = '''
+from oceanbase_tpu.native import crc64
+
+def _stamp(payload):
+    return payload + crc64(payload).to_bytes(8, "little")
+
+def save_blob(path, payload):
+    with open(path, "wb") as f:
+        f.write(_stamp(payload))
+'''
+
+
+def test_io_catches_unverified_write():
+    fs = {"oceanbase_tpu/storage/blob.py": IO_BAD}
+    found = run_all(fs, [check_io_rules])
+    assert _rules(found) == ["io.unverified-write"]
+    # same code outside the durable surface: not under contract
+    fs = {"oceanbase_tpu/exec/blob.py": IO_BAD}
+    assert run_all(fs, [check_io_rules]) == []
+
+
+def test_io_clean_direct_and_transitive():
+    for src in (IO_CLEAN, IO_CLEAN_TRANSITIVE):
+        fs = {"oceanbase_tpu/storage/blob.py": src}
+        assert run_all(fs, [check_io_rules]) == []
+
+
+def test_io_pragma_and_registry():
+    sup = IO_BAD.replace(
+        '    with open(path, "wb") as f:',
+        '    with open(path, "wb") as f:  # obcheck: ok(io.unverified-write)')
+    fs = {"oceanbase_tpu/storage/blob.py": sup}
+    assert run_all(fs, [check_io_rules]) == []
+    # a registered exemption silences the write without a pragma
+    exempt = {"oceanbase_tpu/storage/blob.py": {"save_blob": "transient"}}
+    fs = {"oceanbase_tpu/storage/blob.py": IO_BAD}
+    assert run_all(fs, [lambda az: check_io_rules(az, exempt)]) == []
+
+
+def test_io_registry_hygiene():
+    """Unknown and stale IO_EXEMPT entries are themselves findings —
+    the registry must not rot into a suppression dump."""
+    exempt = {"oceanbase_tpu/storage/blob.py": {
+        "save_blob": "stale: now digest-protected",
+        "ghost_fn": "gone"}}
+    fs = {"oceanbase_tpu/storage/blob.py": IO_CLEAN}
+    found = run_all(fs, [lambda az: check_io_rules(az, exempt)])
+    assert _rules(found) == ["io.unregistered-exemption"]
+    assert len(found) == 2  # one stale, one unknown
+
+
+# ---------------------------------------------------------------------------
+# cancel discipline (blocking loops observe checkpoints)
+# ---------------------------------------------------------------------------
+
+CANCEL_BAD = '''
+def drain(cli, items):
+    out = []
+    for it in items:
+        out.append(cli.call("das.scan", item=it))
+    return out
+'''
+
+CANCEL_CLEAN = '''
+from oceanbase_tpu.server import admission as qadmission
+
+def drain(cli, items):
+    out = []
+    for it in items:
+        qadmission.checkpoint()
+        out.append(cli.call("das.scan", item=it))
+    return out
+'''
+
+CANCEL_NAMESAKE = '''
+def drain(cli, items, tenant):
+    out = []
+    for it in items:
+        tenant.checkpoint()  # the STORAGE checkpoint, not admission
+        out.append(cli.call("das.scan", item=it))
+    return out
+'''
+
+
+def test_cancel_catches_unchecked_loop():
+    fs = {"oceanbase_tpu/exec/pump.py": CANCEL_BAD}
+    found = run_all(fs, [check_cancel_rules])
+    assert _rules(found) == ["cancel.loop-no-checkpoint"]
+    # same loop outside the contract surface: quiet
+    fs = {"oceanbase_tpu/share/pump.py": CANCEL_BAD}
+    assert run_all(fs, [check_cancel_rules]) == []
+    # pure-CPU loop (no rpc/sleep/copy): quiet
+    fs = {"oceanbase_tpu/exec/pump.py":
+          "def f(items):\n    return [i * 2 for i in items]\n"}
+    assert run_all(fs, [check_cancel_rules]) == []
+
+
+def test_cancel_clean_and_namesake_not_satisfying():
+    fs = {"oceanbase_tpu/exec/pump.py": CANCEL_CLEAN}
+    assert run_all(fs, [check_cancel_rules]) == []
+    # a storage-plane `.checkpoint()` namesake must NOT satisfy the rule
+    fs = {"oceanbase_tpu/exec/pump.py": CANCEL_NAMESAKE}
+    found = run_all(fs, [check_cancel_rules])
+    assert _rules(found) == ["cancel.loop-no-checkpoint"]
+
+
+def test_cancel_pragma_and_registry():
+    sup = CANCEL_BAD.replace(
+        "    for it in items:",
+        "    for it in items:  # obcheck: ok(cancel)")
+    fs = {"oceanbase_tpu/exec/pump.py": sup}
+    assert run_all(fs, [check_cancel_rules]) == []
+    exempt = {"oceanbase_tpu/exec/pump.py": {"drain": "unwind path"}}
+    fs = {"oceanbase_tpu/exec/pump.py": CANCEL_BAD}
+    assert run_all(fs, [lambda az: check_cancel_rules(az, exempt)]) == []
+    # hygiene: entries naming clean or missing functions are flagged
+    exempt = {"oceanbase_tpu/exec/pump.py": {"drain": "stale",
+                                             "ghost_fn": "gone"}}
+    fs = {"oceanbase_tpu/exec/pump.py": CANCEL_CLEAN}
+    found = run_all(fs, [lambda az: check_cancel_rules(az, exempt)])
+    assert _rules(found) == ["cancel.stale-exempt", "cancel.unknown-exempt"]
+
+
+def test_cancel_fanout_needs_propagation():
+    fanout = '''
+import threading
+
+def scatter(clients, frag):
+    def run_one(cli):
+        return cli.call("dtl.execute", frag=frag)
+    ts = [threading.Thread(target=run_one) for cli in clients]
+    for t in ts:
+        t.start()
+'''
+    fs = {"oceanbase_tpu/px/scatter.py": fanout}
+    found = run_all(fs, [check_cancel_rules])
+    assert "cancel.fanout-no-propagation" in _rules(found)
+    # a cancel-verb path in the spawning function satisfies it
+    fixed = fanout.replace(
+        "    for t in ts:\n        t.start()",
+        "    for t in ts:\n        t.start()\n"
+        "    for cli in clients:  # obcheck: ok(cancel.loop-no-checkpoint)\n"
+        '        cli.call("dtl.cancel")')
+    fs = {"oceanbase_tpu/px/scatter.py": fixed}
+    found = run_all(fs, [check_cancel_rules])
+    assert "cancel.fanout-no-propagation" not in _rules(found)
+
+
+# ---------------------------------------------------------------------------
+# rpc verb/policy coherence
+# ---------------------------------------------------------------------------
+
+RPC_POLICY_SRC = '''
+POLICIES: dict = {
+    "das.scan":    VerbPolicy(30.0, True, 3),
+    "sql.execute": VerbPolicy(600.0, False),
+}
+'''
+
+RPC_HANDLERS_SRC = '''
+class S:
+    def handlers(self):
+        return {
+            "das.scan": self._h_scan,
+            "node.rogue": self._h_rogue,
+        }
+'''
+
+RPC_RESEND_SRC = '''
+def forward(cli, sql):
+    for _ in range(3):
+        try:
+            return cli.call("sql.execute", sql=sql)
+        except OSError:
+            pass
+'''
+
+
+def test_rpc_missing_policy():
+    fs = {"oceanbase_tpu/net/rpc.py": RPC_POLICY_SRC,
+          "oceanbase_tpu/net/extra.py": RPC_HANDLERS_SRC}
+    found = run_all(fs, [check_rpc_rules])
+    missing = [f for f in found if f.rule == "rpc.missing-policy"]
+    assert len(missing) == 1 and "node.rogue" in missing[0].message
+    assert missing[0].path == "oceanbase_tpu/net/extra.py"
+
+
+def test_rpc_nonidempotent_resend():
+    fs = {"oceanbase_tpu/net/rpc.py": RPC_POLICY_SRC,
+          "oceanbase_tpu/net/fwd.py": RPC_RESEND_SRC}
+    found = run_all(fs, [check_rpc_rules])
+    assert "rpc.nonidempotent-resend" in _rules(found)
+    # an idempotent verb in the same shape is fine
+    fs["oceanbase_tpu/net/fwd.py"] = RPC_RESEND_SRC.replace(
+        "sql.execute", "das.scan")
+    assert run_all(fs, [check_rpc_rules]) == []
+    # pragma round-trip
+    fs["oceanbase_tpu/net/fwd.py"] = RPC_RESEND_SRC.replace(
+        'return cli.call("sql.execute", sql=sql)',
+        'return cli.call(  # obcheck: ok(rpc.nonidempotent-resend)\n'
+        '                "sql.execute", sql=sql)')
+    assert run_all(fs, [check_rpc_rules]) == []
+
+
+def test_rpc_bulk_reply_needs_digest():
+    handler = '''
+def h_scan(table):
+    return {"arrays": {}, "total": 0}
+'''
+    fs = {"oceanbase_tpu/net/extra.py": handler}
+    found = run_all(fs, [check_rpc_rules])
+    assert _rules(found) == ["rpc.bulk-no-digest"]
+    fixed = handler.replace('"total": 0', '"total": 0, "crc": 0')
+    fs = {"oceanbase_tpu/net/extra.py": fixed}
+    assert run_all(fs, [check_rpc_rules]) == []
+
+
+def test_new_families_baseline_round_trip(tmp_path):
+    """cancel/io findings baseline like every other family: the seeded
+    violation lands green once baselined, a second one is new."""
+    fs = {"oceanbase_tpu/exec/pump.py": CANCEL_BAD,
+          "oceanbase_tpu/storage/blob.py": IO_BAD}
+    first = run_all(fs, [check_cancel_rules, check_io_rules])
+    assert _rules(first) == ["cancel.loop-no-checkpoint",
+                             "io.unverified-write"]
+    bp = str(tmp_path / "base.json")
+    write_baseline(first, bp)
+    base = load_baseline(bp)
+    assert diff_findings(first, base) == []
+    fs["oceanbase_tpu/storage/blob.py"] = IO_BAD + (
+        '\ndef save_other(path, b):\n'
+        '    with open(path, "wb") as f:\n'
+        '        f.write(b)\n')
+    second = run_all(fs, [check_cancel_rules, check_io_rules])
+    new = diff_findings(second, base)
+    assert len(new) == 1 and new[0].func == "save_other"
+
+
+# ---------------------------------------------------------------------------
 # baseline diffing
 # ---------------------------------------------------------------------------
 
@@ -419,3 +679,38 @@ def test_cli_ci_gate_end_to_end(tmp_path):
     assert r.returncode == 0
     r = run("--ci")
     assert r.returncode == 0
+
+
+def test_cli_family_filter(tmp_path):
+    """--family narrows both the checkers run and the reported rules,
+    and the --json summary carries per-family timings."""
+    root = tmp_path / "mini"
+    pkg = root / "oceanbase_tpu" / "storage"
+    pkg.mkdir(parents=True)
+    (pkg / "blob.py").write_text(IO_BAD)
+    (root / "oceanbase_tpu" / "exec").mkdir()
+    (root / "oceanbase_tpu" / "exec" / "pump.py").write_text(CANCEL_BAD)
+    script = os.path.join(REPO, "scripts", "obcheck.py")
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, script, "--root", str(root),
+             "--baseline", str(tmp_path / "none.json"), *extra],
+            capture_output=True, text=True)
+
+    r = run("--json", "--family", "io")
+    summary = json.loads(r.stdout.splitlines()[0])
+    assert set(summary["by_rule"]) == {"io.unverified-write"}
+    assert set(summary["family_s"]) == {"io"}
+    # a full-rule prefix also selects its family
+    r = run("--json", "--family", "cancel.loop-no-checkpoint")
+    summary = json.loads(r.stdout.splitlines()[0])
+    assert set(summary["by_rule"]) == {"cancel.loop-no-checkpoint"}
+    # two prefixes compose
+    r = run("--json", "--family", "io", "--family", "cancel")
+    summary = json.loads(r.stdout.splitlines()[0])
+    assert set(summary["by_rule"]) == {"io.unverified-write",
+                                       "cancel.loop-no-checkpoint"}
+    # --write-baseline refuses a partial run
+    r = run("--write-baseline", "--family", "io")
+    assert r.returncode == 2
