@@ -18,12 +18,25 @@ the verifier) live in the audited ``IO_EXEMPT`` registry.  Rules:
 - ``io.unverified-write``        — binary create-mode write with no
                                    digest helper in the writer's call
                                    closure, not registered, no pragma;
-- ``io.unregistered-exemption``  — registry hygiene: an ``IO_EXEMPT``
-                                   entry naming a function that no
-                                   longer exists (unknown) or one whose
-                                   writes are now digest-protected
-                                   (stale) — the registry must not rot
-                                   into a suppression dump.
+- ``io.inplace-durable-write``   — a create-mode ``open`` (binary OR
+                                   text) in the durable surface that
+                                   writes its final path directly: a
+                                   crash mid-write leaves a TORN
+                                   current-generation artifact.  The
+                                   discipline is stage-then-publish —
+                                   write a ``*.tmp`` sibling and
+                                   ``os.replace`` it over the real name
+                                   (append-mode writes are exempt: the
+                                   unwind protocol truncates them back).
+                                   Verified-staging writers live in the
+                                   audited ``INPLACE_EXEMPT`` registry;
+- ``io.unregistered-exemption``  — registry hygiene: an ``IO_EXEMPT``/
+                                   ``INPLACE_EXEMPT`` entry naming a
+                                   function that no longer exists
+                                   (unknown) or one whose writes no
+                                   longer trip the rule (stale) — the
+                                   registries must not rot into
+                                   suppression dumps.
 """
 
 from __future__ import annotations
@@ -54,6 +67,10 @@ DIGEST_HELPERS = {"crc64", "bytes_crc", "arrays_crc", "chunk_crc",
 #: format whose entries self-verify; text modes are config/docs)
 WRITE_MODES = {"wb", "xb", "wb+", "xb+", "w+b", "x+b"}
 
+#: every create mode (binary + text) — the in-place rule covers both:
+#: a torn manifest.json is as fatal as a torn segment
+CREATE_MODES = WRITE_MODES | {"w", "x", "w+", "x+", "wt", "xt"}
+
 #: audited transient-by-design writers: path -> qualname -> why the
 #: missing digest is correct.  The exemption documents the audit, it
 #: does not waive review.
@@ -69,6 +86,32 @@ IO_EXEMPT: dict[str, dict[str, str]] = {
             "self-signed PEM pair: ssl.load_cert_chain is the"
             " verify-on-load (a corrupt PEM fails loudly at server"
             " start) and the pair is regenerated, not repaired",
+    },
+}
+
+#: audited direct-path writers for io.inplace-durable-write: functions
+#: whose create-mode writes are safe WITHOUT tmp+rename because the
+#: destination is itself a staging/ephemeral artifact or is verified
+#: before install.  path -> qualname -> why.
+INPLACE_EXEMPT: dict[str, dict[str, str]] = {
+    "oceanbase_tpu/storage/scrub.py": {
+        "Scrubber._repair_from_peer":
+            "writes the fetched manifest into the .scrub_tmp staging"
+            " dir, which is rmtree'd and rebuilt per attempt; segments"
+            " install from staging only after digest verification",
+    },
+    "oceanbase_tpu/net/rebuild.py": {
+        "fetch_file":
+            "rebuild/scrub staging download: every chunk is"
+            " crc-verified before the write and the whole file against"
+            " the peer digest after assembly — a torn dst is re-fetched"
+            " wholesale, never trusted",
+    },
+    "oceanbase_tpu/server/tls.py": {
+        "ensure_server_credentials":
+            "self-signed PEM pair regenerated on any load failure:"
+            " ssl.load_cert_chain verifies at server start and a torn"
+            " PEM is replaced, not repaired",
     },
 }
 
@@ -93,6 +136,53 @@ def _write_mode(call: ast.Call) -> str | None:
             mode_node.value in WRITE_MODES:
         return mode_node.value
     return None
+
+
+def _create_mode(call: ast.Call) -> str | None:
+    """The create mode (binary or text) of an ``open``/``os.fdopen``
+    call, else None."""
+    d = dotted_name(call.func)
+    if d not in ("open", "os.fdopen"):
+        return None
+    mode_node = call.args[1] if len(call.args) >= 2 else None
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if isinstance(mode_node, ast.Constant) and \
+            isinstance(mode_node.value, str) and \
+            mode_node.value in CREATE_MODES:
+        return mode_node.value
+    return None
+
+
+def _path_is_staged(call: ast.Call) -> bool:
+    """True when the open's path expression visibly names a staging
+    artifact: a ``*.tmp``-suffixed string, or a variable/attribute
+    whose name contains ``tmp`` (``tmp``, ``tmp_path``, ``state_tmp``).
+    Under-detection only ever over-reports into the audited registry,
+    never silently passes a direct write."""
+    node = call.args[0] if call.args else None
+    if node is None:
+        return False
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and "tmp" in n.value.lower():
+            return True
+        if isinstance(n, ast.Name) and "tmp" in n.id.lower():
+            return True
+        if isinstance(n, ast.Attribute) and "tmp" in n.attr.lower():
+            return True
+    return False
+
+
+def _publishes_by_rename(fnode: ast.AST) -> bool:
+    """Does this function (own statements only) call os.replace /
+    os.rename — i.e. stage-then-publish within the same frame?"""
+    for n in _walk_own(fnode):
+        if isinstance(n, ast.Call) and \
+                dotted_name(n.func) in ("os.replace", "os.rename"):
+            return True
+    return False
 
 
 def _resolve_with_methods(idx: _Index, path: str, call: ast.Call
@@ -156,16 +246,39 @@ def _binary_writes(info) -> list[tuple[ast.Call, str]]:
 
 
 def check_io_rules(az: Analyzer,
-                   exempt: dict[str, dict[str, str]] | None = None
+                   exempt: dict[str, dict[str, str]] | None = None,
+                   inplace_exempt: dict[str, dict[str, str]] | None = None
                    ) -> list[Finding]:
     exempt = IO_EXEMPT if exempt is None else exempt
+    inplace_exempt = (INPLACE_EXEMPT if inplace_exempt is None
+                      else inplace_exempt)
     idx = _Index(az)
     out: list[Finding] = []
     writers: dict[tuple[str, str], bool] = {}  # key -> protected?
+    #: key -> has at least one direct-path create write (pre-exemption)
+    inplace_writers: dict[tuple[str, str], bool] = {}
     for path in _scope_files(az):
         for (p, qual), info in idx.funcs.items():
             if p != path:
                 continue
+            creates = [(n, m) for n in _walk_own(info.node)
+                       if isinstance(n, ast.Call)
+                       and (m := _create_mode(n))]
+            if creates:
+                renames = _publishes_by_rename(info.node)
+                direct = [(c, m) for c, m in creates
+                          if not renames and not _path_is_staged(c)]
+                inplace_writers[(p, qual)] = bool(direct)
+                if qual not in inplace_exempt.get(p, {}):
+                    for call, mode in direct:
+                        out.append(Finding(
+                            "io.inplace-durable-write", p, call.lineno,
+                            qual,
+                            f'create-mode write (mode "{mode}") lands '
+                            f'on its final path: a crash mid-write '
+                            f'tears the current generation — stage a '
+                            f'*.tmp sibling and os.replace it, or '
+                            f'register in io_rules.INPLACE_EXEMPT'))
             writes = _binary_writes(info)
             if not writes:
                 continue
@@ -198,4 +311,20 @@ def check_io_rules(az: Analyzer,
                     idx.funcs[key].node.lineno, qual,
                     f"stale IO_EXEMPT entry: {qual!r} has no "
                     f"unverified binary write anymore (prune it)"))
+    for path, entries in sorted(inplace_exempt.items()):
+        if path not in az.trees:
+            continue
+        for qual in sorted(entries):
+            key = (path, qual)
+            if key not in idx.funcs:
+                out.append(Finding(
+                    "io.unregistered-exemption", path, 1, qual,
+                    f"INPLACE_EXEMPT names unknown function {qual!r} "
+                    f"(renamed or removed? prune the entry)"))
+            elif not inplace_writers.get(key, False):
+                out.append(Finding(
+                    "io.unregistered-exemption", path,
+                    idx.funcs[key].node.lineno, qual,
+                    f"stale INPLACE_EXEMPT entry: {qual!r} has no "
+                    f"direct-path create write anymore (prune it)"))
     return out

@@ -37,8 +37,14 @@ class CdcPump:
         ldr = wal.replicas[wal.leader_id]
         committed = ldr.committed_lsn
         out: list[ChangeEvent] = []
+        if self.next_lsn < ldr.base_lsn:
+            # WAL recycle dropped entries this cursor never consumed:
+            # they were applied + checkpointed long ago — a consumer
+            # this stale resumes at the recycle point (≙ obcdc falling
+            # back to the archive when the online log is recycled)
+            self.next_lsn = ldr.base_lsn
         while self.next_lsn < committed:
-            e = ldr.entries[self.next_lsn]
+            e = ldr.entries[self.next_lsn - ldr.base_lsn]
             self.next_lsn += 1
             try:
                 rec = json.loads(e.payload.decode())

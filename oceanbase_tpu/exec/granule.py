@@ -288,6 +288,7 @@ def execute_sorted_streamed(
     plan: pp.PlanNode, chunk_provider, spill_dir: str,
     chunk_rows: int = DEFAULT_CHUNK_ROWS,
     budget_rows: int = 1 << 22, types: dict | None = None,
+    disk_budget=None, faults=None, label: str = "",
 ):
     """ORDER BY over a table larger than host memory: granules filter on
     device, live rows drain to host, and the external merge sort
@@ -357,7 +358,8 @@ def execute_sorted_streamed(
     parts_a: list = []
     parts_v: list = []
     got = 0
-    with TempFileStore(spill_dir) as store:
+    with TempFileStore(spill_dir, budget=disk_budget, faults=faults,
+                       label=label) as store:
         for arrays, valids in external_sort(
                 host_chunks(), key_cols, sort_node.ascending, store,
                 budget_rows=budget_rows):

@@ -125,13 +125,19 @@ def execute_spilled(plan: pp.PlanNode, providers: dict, spill_dir: str,
                     budget_rows: int, device_tables: dict | None = None,
                     types_by_table: dict | None = None,
                     big_tables: set | None = None,
-                    chunk_rows: int = DEFAULT_CHUNK_ROWS):
+                    chunk_rows: int = DEFAULT_CHUNK_ROWS,
+                    disk_budget=None, faults=None, label: str = ""):
     """Run ``plan`` with disk spill for everything over ``budget_rows``.
 
     providers: {table: chunk_provider} for the over-budget tables
     (re-iterable granule streams).  device_tables: {table: Relation} for
     every other referenced table (lowered whole).  -> (arrays, valids,
     dtypes, SpillStats); raises NotDistributable for unsupported shapes.
+
+    ``disk_budget``/``faults``/``label`` thread the disk-pressure plane
+    into the temp-file store: chunk writes are accounted against the
+    tenant spill budget (SpillBudgetExceeded kills just this statement)
+    and consult the fault plane (seeded ENOSPC/EIO, kind="spill").
     """
     # granule capacity rides the shared bucket ladder so the per-chunk
     # device programs compile once per ladder rung, not per config value
@@ -158,7 +164,8 @@ def execute_spilled(plan: pp.PlanNode, providers: dict, spill_dir: str,
     import time as _time
 
     m0 = _time.monotonic()
-    with TempFileStore(spill_dir) as store, \
+    with TempFileStore(spill_dir, budget=disk_budget, faults=faults,
+                       label=label) as store, \
             qtrace.span("spill.execute") as tsp:
         ctx = _Ctx(store, budget_rows, chunk_rows, providers,
                    device_tables or {}, types_by_table or {}, big)

@@ -30,10 +30,21 @@ Rule vocabulary (the actions the consult sites understand):
     crash   os._exit(137) — a process failure mid-protocol.
     bitflip/truncate (where="disk" only): corrupt a just-persisted file
             in place — ``verb`` names the artifact kind (segment,
-            manifest, slog, wal).  The persistence boundaries
-            (StorageEngine / PalfReplica) consult ``act_disk`` after
-            every durable write, so seeded disk-rot schedules replay
-            deterministically against the checksum + scrub plane.
+            manifest, slog, wal, spill, backup).  The persistence
+            boundaries (StorageEngine / PalfReplica) consult
+            ``act_disk`` after every durable write, so seeded disk-rot
+            schedules replay deterministically against the checksum +
+            scrub plane.
+    enospc/eio/partial (where="disk" only): write-ERROR injection,
+            consulted via ``check_write`` BEFORE/INSIDE the durable
+            writers (not after them like the rot rules).  enospc and
+            eio raise ``OSError(errno.ENOSPC/EIO)`` with no bytes
+            written; partial directs the writer to persist a seeded
+            fraction of the batch and THEN fail with ENOSPC — the
+            torn-write case the unwind paths (WAL truncate-back,
+            tmp+rename) must clean up.  The boundaries normalize the
+            OSError into typed DiskFull/DiskIOError
+            (server/diskmgr.py).
 
 Matching: verb (None = any), peer node id (None = any; on the client
 side the destination, on the server side the sender's ``src`` field),
@@ -44,6 +55,7 @@ the rule id, so schedules are reproducible frame-for-frame.
 
 from __future__ import annotations
 
+import errno as _errno
 import itertools
 import os
 import random
@@ -53,11 +65,19 @@ from dataclasses import dataclass, field
 
 WHERES = ("send", "recv", "reply", "disk")
 ACTIONS = ("drop", "reset", "delay", "garble", "crash",
-           "bitflip", "truncate")
+           "bitflip", "truncate", "enospc", "eio", "partial")
 
-#: artifact kinds the persistence boundaries report to ``act_disk``
-#: (rule.verb matches against these; None = any artifact)
-DISK_KINDS = ("segment", "manifest", "slog", "wal")
+#: post-write rot actions vs pre-write errno actions — both pair only
+#: with where="disk" but consult at different boundaries (act_disk
+#: after a durable write, check_write before/inside it), so each
+#: consult site filters to its own family and the nth/count gates of
+#: one family never tick on the other's matches
+DISK_ROT_ACTIONS = ("bitflip", "truncate")
+DISK_ERRNO_ACTIONS = ("enospc", "eio", "partial")
+
+#: artifact kinds the persistence boundaries report to ``act_disk`` /
+#: ``check_write`` (rule.verb matches against these; None = any)
+DISK_KINDS = ("segment", "manifest", "slog", "wal", "spill", "backup")
 
 
 class FaultDrop(ConnectionError):
@@ -121,9 +141,10 @@ class FaultPlane:
             raise ValueError(
                 "garble is not applicable to where='recv'; use "
                 "where='send' to corrupt requests")
-        if (action in ("bitflip", "truncate")) != (where == "disk"):
+        disk_only = DISK_ROT_ACTIONS + DISK_ERRNO_ACTIONS
+        if (action in disk_only) != (where == "disk"):
             raise ValueError(
-                "bitflip/truncate pair only with where='disk' "
+                f"{'/'.join(disk_only)} pair only with where='disk' "
                 "(persisted-file faults; verb names the artifact kind)")
         if where == "disk" and verb is not None and \
                 verb not in DISK_KINDS:
@@ -177,7 +198,8 @@ class FaultPlane:
              nth: int | None = None, count: int = 1,
              prob: float = 1.0, seed: int | None = None) -> int:
         """Arm one persisted-file fault: ``action`` in
-        {bitflip, truncate}, ``kind`` in DISK_KINDS (None = any).
+        {bitflip, truncate} (post-write rot) or {enospc, eio, partial}
+        (pre-write errno), ``kind`` in DISK_KINDS (None = any).
         Defaults to a one-shot (count=1) — media rot, not a firehose."""
         return self.inject("disk", action, verb=kind, nth=nth,
                            count=count, prob=prob, seed=seed)
@@ -261,7 +283,7 @@ class FaultPlane:
         actions: list[tuple[str, random.Random]] = []
         with self._lock:
             for r in self._rules:
-                if r.where != "disk":
+                if r.where != "disk" or r.action not in DISK_ROT_ACTIONS:
                     continue
                 if r.verb is not None and r.verb != kind:
                     continue
@@ -281,6 +303,56 @@ class FaultPlane:
                 bitflip_file(path, rng=rng)
             elif action == "truncate":
                 truncate_file(path, rng=rng)
+
+    def check_write(self, kind: str, path: str | None = None,
+                    nbytes: int | None = None) -> int | None:
+        """Consult the plane BEFORE durably writing an artifact of
+        ``kind`` (the errno half of the disk plane; the rot half is
+        ``act_disk`` after the write).
+
+        - an armed ``enospc``/``eio`` rule raises
+          ``OSError(errno.ENOSPC/EIO)`` — no bytes were written;
+        - an armed ``partial`` rule returns how many of the batch's
+          ``nbytes`` the writer must persist before failing with
+          ENOSPC (a seeded fraction in (0, 1) of the batch) — the
+          torn-write case; writers that cannot do partial writes (or
+          pass no ``nbytes``) get a plain ENOSPC raise instead;
+        - no matching rule -> None (proceed).
+
+        The no-rules fast path is one attribute read."""
+        if not self._rules:
+            return None
+        verdict: tuple[str, random.Random] | None = None
+        with self._lock:
+            for r in self._rules:
+                if r.where != "disk" or \
+                        r.action not in DISK_ERRNO_ACTIONS:
+                    continue
+                if r.verb is not None and r.verb != kind:
+                    continue
+                r.matched += 1
+                if r.nth is not None and r.matched != r.nth:
+                    continue
+                if r.count == 0:
+                    continue
+                if r.prob < 1.0 and r.rng.random() >= r.prob:
+                    continue
+                if r.count > 0:
+                    r.count -= 1
+                r.fired += 1
+                if verdict is None:
+                    verdict = (r.action, r.rng)
+        if verdict is None:
+            return None
+        action, rng = verdict
+        if action == "eio":
+            raise OSError(_errno.EIO,
+                          f"fault: injected EIO on {kind} write", path)
+        if action == "partial" and nbytes is not None and nbytes > 1:
+            return max(1, min(nbytes - 1,
+                              int(nbytes * rng.uniform(0.1, 0.9))))
+        raise OSError(_errno.ENOSPC,
+                      f"fault: injected ENOSPC on {kind} write", path)
 
 
 def bitflip_file(path: str, rng: random.Random | None = None,

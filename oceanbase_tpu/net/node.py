@@ -201,12 +201,18 @@ class NodeServer:
         # enable_disk_faults in _h_fault_inject)
         self.engine.faults = self.faults
         self.palf.replica.faults = self.faults
+        # disk-pressure degradation hooks: entering read-only hands
+        # PALF leadership to a peer with headroom (writes land there);
+        # exiting needs no action — the location cache re-learns
+        self.tenant.diskmgr.on_readonly = self._on_disk_readonly
         self.tx = self.tenant.tx
         self.catalog = self.tenant.catalog
         # replicate logical DDL through the log stream (followers apply
         # in _apply_entry; physical segment ops stay node-local)
         self.engine.ddl_wal_cb = self._on_local_ddl
         self.db = NodeDatabase(self, root)
+        # backup/spill writers reach the fault plane through the db
+        self.db.faults = self.faults
         if boot_trace.spans:
             self.db.trace_registry.add(boot_trace.snapshot())
         from oceanbase_tpu.px.dtl import DtlExchange
@@ -247,8 +253,10 @@ class NodeServer:
             "metrics.scrape": self._h_metrics,
             "fault.inject": self._h_fault_inject,
             "fault.clear": self._h_fault_clear,
+            "config.set": self._h_config_set,
             "scrub.checksum": self.scrubber.checksum_handler,
             "scrub.run": self._h_scrub_run,
+            "disk.takeover": self._h_disk_takeover,
             **self.rebuild.handlers(),
             **self.palf.handlers(),
         }
@@ -358,6 +366,21 @@ class NodeServer:
         return {"removed": self.faults.clear(rule_id),
                 "node_id": self.node_id}
 
+    def _h_config_set(self, name: str, value):
+        """Admin verb: set one config knob on THIS node (≙ ALTER
+        SYSTEM SET ... SERVER 'ip:port', which scopes a change to a
+        single observer).  SQL ALTER SYSTEM routes to the leader, so
+        retuning a specific replica — e.g. lifting the log budget on
+        a demoted, disk-pressured node — needs the node-scoped path.
+        A disk-budget change polls the disk manager immediately:
+        budget crossings (and read-only auto-exit) must not ride out
+        the checkpoint-loop cadence."""
+        self.config.set(str(name), value)
+        if str(name).endswith("_disk_limit_bytes"):
+            self.tenant.diskmgr.poll(force=True)
+        return {"node_id": self.node_id, "name": str(name),
+                "read_only": bool(self.tenant.diskmgr.read_only)}
+
     def _h_scrub_run(self):
         """Admin verb: run one scrub round NOW (detect → quarantine →
         repair → parity) and return its summary — the periodic loop's
@@ -372,6 +395,43 @@ class NodeServer:
         self.location.invalidate()
         if not self._stop.is_set():
             self.palf.on_peer_down(pid)
+
+    def _on_disk_readonly(self, surface: str):
+        """Read-only entry hook (server/diskmgr): if this node leads
+        the PALF group, hand leadership to a peer with log-disk
+        headroom so cluster writes keep landing somewhere — the
+        relinquish runs OFF the write path (the hook fires inside a
+        failing writer's poll)."""
+        if not self.palf.is_leader or self._stop.is_set():
+            return
+
+        def _relinquish():
+            for pid in sorted(self.peers):
+                qadmission.checkpoint()  # KILL/deadline between peers
+                try:
+                    if self.peers[pid].call("disk.takeover",
+                                            from_node=self.node_id):
+                        self.location.invalidate()
+                        return
+                except OSError:
+                    continue
+
+        threading.Thread(target=_relinquish, daemon=True).start()
+
+    def _h_disk_takeover(self, from_node=None):
+        """A disk-pressured leader asks THIS node to campaign.  Refuse
+        when our own log surface is degraded (shifting leadership onto
+        another full disk helps nobody); otherwise run one election —
+        winning demotes the pressured leader via the term bump."""
+        dm = self.tenant.diskmgr
+        dm.poll(force=True)
+        if dm.read_only or dm.state("log") in ("pressure", "full"):
+            return False
+        try:
+            self.palf.elect()
+            return True
+        except (NoQuorum, OSError):
+            return False
 
     def _h_scan(self, table: str, snapshot: int | None = None,
                 offset: int = 0, limit: int = SCAN_CHUNK_ROWS):
@@ -737,6 +797,13 @@ class NodeServer:
                     self.tenant.checkpoint()
             except Exception:
                 pass  # transient flush failure: retry next interval
+            try:
+                # disk-pressure poll rides the same cadence: budget
+                # crossings degrade (and read-only auto-exits) even on
+                # a node receiving no writes
+                self.tenant.diskmgr.poll()
+            except Exception:
+                pass
 
     def _scrub_loop(self):
         """Periodic scrub rounds (storage/scrub.py): local re-verify,

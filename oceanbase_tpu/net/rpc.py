@@ -152,6 +152,16 @@ POLICIES: dict[str, VerbPolicy] = {
     # so both carry bounded retry budgets
     "scrub.checksum": VerbPolicy(60.0, True, 2, 0.05, 0.50),
     "scrub.run":      VerbPolicy(300.0, True, 1, 0.10, 1.00),
+    # disk.takeover asks a peer with log-disk headroom to campaign:
+    # elections are idempotent (a re-ask of the winner is a no-op, of a
+    # loser another bounded campaign), so a lost reply may retry once
+    "disk.takeover":  VerbPolicy(10.0, True, 1, 0.05, 0.50),
+    # config.set writes one knob on the SERVING node (≙ ALTER SYSTEM
+    # SET ... SERVER=...): re-setting the same value is a no-op, so a
+    # lost reply may retry once; the deadline is generous because a
+    # disk-budget change force-polls the disk manager, which can run a
+    # full reclaim round (checkpoint + WAL recycle) synchronously
+    "config.set":     VerbPolicy(30.0, True, 1, 0.05, 0.50),
     # dtl.cancel sets a cancel flag keyed by statement token — setting
     # an already-set flag is a no-op, trivially idempotent; it must
     # fail FAST (the canceller is usually unwinding a kill/timeout)

@@ -157,7 +157,12 @@ class PalfCluster:
         prev = min(ldr.last_lsn(), follower.last_lsn())
         while prev > 0 and follower.term_at(prev) != ldr.term_at(prev):
             prev -= 1
-        batch = ldr.entries[prev:]
+        batch = ldr.entries_from(prev)
+        if batch is None:
+            # the match point predates the leader's WAL-recycle base:
+            # the history is physically gone — this follower needs the
+            # rebuild plane, not catch-up
+            return False
         return follower.accept(prev, ldr.term_at(prev), batch)
 
     def _broadcast_commit(self, commit_lsn: int):
@@ -193,6 +198,15 @@ class PalfCluster:
     def revive(self, replica_id: int):
         with self._lock:
             self.down.discard(replica_id)
+
+    def recycle(self, upto_lsn: int) -> int:
+        """WAL recycle across every replica (each clamps to its own
+        commit/apply point); -> bytes reclaimed on disk."""
+        with self._lock:
+            freed = 0
+            for r in self.replicas.values():
+                freed += r.recycle(upto_lsn)
+            return freed
 
     def committed_lsn(self) -> int:
         if self.leader_id is not None and self.leader_id not in self.down:

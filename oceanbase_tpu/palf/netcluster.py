@@ -100,18 +100,26 @@ class NetPalf:
                 return False
             prev = min(r.last_lsn(), int(st["last_lsn"]))
             while prev > 0:
+                batch = r.entries_from(prev)
+                if batch is None:
+                    # prev predates our WAL-recycle base: the history
+                    # is gone — this follower needs the rebuild plane
+                    return False
                 ok = cli.call(
                     "palf.accept", prev_lsn=prev,
                     prev_term=r.term_at(prev),
-                    entries=_encode_entries(r.entries[prev:]),
+                    entries=_encode_entries(batch),
                     leader_id=self.node_id, commit=commit,
                     term=r.current_term)
                 if ok:
                     return True
                 prev -= 1
+            batch = r.entries_from(0)
+            if batch is None:
+                return False  # recycled: cannot ship from lsn 0
             return bool(cli.call(
                 "palf.accept", prev_lsn=0, prev_term=0,
-                entries=_encode_entries(r.entries),
+                entries=_encode_entries(batch),
                 leader_id=self.node_id, commit=commit,
                 term=r.current_term))
         except OSError:
@@ -310,6 +318,11 @@ class NetPalf:
     # ------------------------------------------------------------------
     def committed_lsn(self) -> int:
         return self.replica.committed_lsn
+
+    def recycle(self, upto_lsn: int) -> int:
+        """WAL recycle of THIS process's replica (peers recycle on
+        their own checkpoint cadence); -> bytes reclaimed on disk."""
+        return self.replica.recycle(upto_lsn)
 
     def close(self):
         self.replica.close()

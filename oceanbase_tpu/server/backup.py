@@ -28,9 +28,36 @@ import shutil
 import time
 
 from oceanbase_tpu.server import admission as qadmission
+from oceanbase_tpu.server.diskmgr import (
+    DiskFull,
+    DiskIOError,
+    wrap_disk_error,
+)
 from oceanbase_tpu.storage.integrity import CorruptionError
 
 MANIFEST = "BACKUP_MANIFEST.json"
+
+
+def _faults(db):
+    """The node's fault plane (net/faults.FaultPlane) when armed —
+    backup writes consult it per destination file (kind="backup")."""
+    return getattr(db, "faults", None)
+
+
+def _check_backup_write(faults, dst: str):
+    if faults is not None:
+        faults.check_write("backup", dst)
+
+
+def _write_json_atomic(path: str, obj):
+    """Manifest/state writes publish by rename: a failed write leaves
+    the previous generation intact, never a torn current file."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(obj, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
 
 
 def _walk(root: str) -> dict[str, int]:
@@ -83,14 +110,33 @@ def full_backup(db, dest: str) -> str:
     if db.root is None:
         raise ValueError("in-memory database cannot be backed up")
     db.checkpoint()
+    faults = _faults(db)
     os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
-    shutil.copytree(db.root, dest, dirs_exist_ok=False)
-    _verify_backup_wal(dest)
-    files = _walk(dest)
-    files.pop(MANIFEST, None)
-    with open(os.path.join(dest, MANIFEST), "w") as fh:
-        json.dump({"kind": "full", "base": None, "ts": time.time(),
-                   "files": files}, fh)
+
+    def _copy(src, dst, *, follow_symlinks=True):
+        # wrap to typed IMMEDIATELY: copytree folds bare OSErrors into
+        # a shutil.Error that loses the errno (ENOSPC vs EIO)
+        try:
+            _check_backup_write(faults, dst)
+            return shutil.copy2(src, dst,
+                                follow_symlinks=follow_symlinks)
+        except OSError as exc:
+            raise wrap_disk_error(exc, f"backup copy {dst}") from exc
+
+    try:
+        shutil.copytree(db.root, dest, dirs_exist_ok=False,
+                        copy_function=_copy)
+        _verify_backup_wal(dest)
+        files = _walk(dest)
+        files.pop(MANIFEST, None)
+        _check_backup_write(faults, os.path.join(dest, MANIFEST))
+        _write_json_atomic(os.path.join(dest, MANIFEST),
+                           {"kind": "full", "base": None,
+                            "ts": time.time(), "files": files})
+    except (OSError, DiskFull, DiskIOError) as exc:
+        # a half-made backup must not survive to be resumed/restored
+        shutil.rmtree(dest, ignore_errors=True)
+        raise wrap_disk_error(exc, f"full backup to {dest}") from exc
     return dest
 
 
@@ -105,26 +151,36 @@ def incremental_backup(db, dest: str, base: str) -> str:
     with open(os.path.join(base, MANIFEST)) as fh:
         base_m = json.load(fh)
     db.checkpoint()
+    faults = _faults(db)
     os.makedirs(dest, exist_ok=False)
     copied, skipped = {}, 0
-    for rel, size in _walk(db.root).items():
-        qadmission.checkpoint()  # KILL/deadline between file copies
-        if rel == MANIFEST:
-            continue
-        src = os.path.join(db.root, rel)
-        immutable = "segments" + os.sep in rel or rel.endswith(".seg")
-        if immutable and base_m["files"].get(rel) == size:
-            skipped += 1
-            continue
-        dst = os.path.join(dest, rel)
-        os.makedirs(os.path.dirname(dst), exist_ok=True)
-        shutil.copy2(src, dst)
-        copied[rel] = size
-    _verify_backup_wal(dest)
-    with open(os.path.join(dest, MANIFEST), "w") as fh:
-        json.dump({"kind": "incremental", "base": os.path.abspath(base),
-                   "ts": time.time(), "files": copied,
-                   "skipped": skipped}, fh)
+    try:
+        for rel, size in _walk(db.root).items():
+            qadmission.checkpoint()  # KILL/deadline between file copies
+            if rel == MANIFEST:
+                continue
+            src = os.path.join(db.root, rel)
+            immutable = "segments" + os.sep in rel or rel.endswith(".seg")
+            if immutable and base_m["files"].get(rel) == size:
+                skipped += 1
+                continue
+            dst = os.path.join(dest, rel)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            _check_backup_write(faults, dst)
+            shutil.copy2(src, dst)
+            copied[rel] = size
+        _verify_backup_wal(dest)
+        _check_backup_write(faults, os.path.join(dest, MANIFEST))
+        _write_json_atomic(os.path.join(dest, MANIFEST),
+                           {"kind": "incremental",
+                            "base": os.path.abspath(base),
+                            "ts": time.time(), "files": copied,
+                            "skipped": skipped})
+    except OSError as exc:
+        # a half-made increment must not survive as a chain link
+        shutil.rmtree(dest, ignore_errors=True)
+        raise wrap_disk_error(
+            exc, f"incremental backup to {dest}") from exc
     return dest
 
 
@@ -132,6 +188,7 @@ def archive_wal(db, dest: str):
     """Append-only WAL archiving: copies each replica log's NEW suffix
     (byte offset recorded per file — ≙ archive progress points)."""
     os.makedirs(dest, exist_ok=True)
+    faults = _faults(db)
     state_p = os.path.join(dest, "ARCHIVE_STATE.json")
     state = {}
     if os.path.exists(state_p):
@@ -149,12 +206,30 @@ def archive_wal(db, dest: str):
             start = state.get(rel, 0)
             size = os.path.getsize(src)
             if size > start:
-                with open(src, "rb") as s, open(dst, "ab") as d:
-                    s.seek(start)
-                    shutil.copyfileobj(s, d)
+                try:
+                    _check_backup_write(faults, dst)
+                    with open(src, "rb") as s, open(dst, "ab") as d:
+                        s.seek(start)
+                        shutil.copyfileobj(s, d)
+                        d.flush()
+                        os.fsync(d.fileno())
+                except OSError as exc:
+                    # append-only discipline: truncate the archive copy
+                    # back to the recorded progress point so the next
+                    # round re-appends from a clean suffix boundary
+                    try:
+                        with open(dst, "ab") as d:
+                            d.truncate(start)
+                    except OSError:
+                        pass
+                    raise wrap_disk_error(
+                        exc, f"wal archive {dst}") from exc
                 state[rel] = size
-    with open(state_p, "w") as fh:
-        json.dump(state, fh)
+    try:
+        _check_backup_write(faults, state_p)
+        _write_json_atomic(state_p, state)
+    except OSError as exc:
+        raise wrap_disk_error(exc, "wal archive state") from exc
     return dest
 
 
@@ -208,7 +283,8 @@ def pitr_cut(target: str, until_version: int):
     Every entry's stored crc64 is VERIFIED before the rewrite: the cut
     re-encodes entries, which would otherwise launder corrupt payloads
     into fresh valid checksums the restored node then trusts."""
-    from oceanbase_tpu.palf.log import _MAGIC, LogEntry, scan_wal
+    from oceanbase_tpu.palf.log import _BASE_PAYLOAD, _MAGIC, LogEntry, \
+        scan_wal
 
     for dirpath, _dirs, files in os.walk(target):
         for f in files:
@@ -226,6 +302,13 @@ def pitr_cut(target: str, until_version: int):
                 raise CorruptionError(
                     f"PITR source WAL entry lsn={crc_failed_lsn} crc "
                     f"mismatch: {path}", kind="wal", path=path)
+            # a recycled WAL leads with its base record — preserve it
+            # verbatim and renumber the tail from base_lsn + 1 (recycled
+            # entries are checkpointed history at/below the cut)
+            base_rec = None
+            if entries and entries[0].payload == _BASE_PAYLOAD:
+                base_rec = entries[0]
+                entries = entries[1:]
             kept: list[LogEntry] = []
             for e in entries:
                 try:
@@ -237,9 +320,14 @@ def pitr_cut(target: str, until_version: int):
                     continue  # drop: this tx commits after the cut
                 kept.append(e)
             # re-number LSNs densely (accept() requires a gapless log)
+            first = (base_rec.lsn + 1) if base_rec is not None else 1
             tmp = path + ".tmp"
             with open(tmp, "wb") as fh:
                 fh.write(_MAGIC)
-                for i, e in enumerate(kept, 1):
+                if base_rec is not None:
+                    fh.write(base_rec.encode())
+                for i, e in enumerate(kept, first):
                     fh.write(LogEntry(e.term, i, e.payload).encode())
+                fh.flush()
+                os.fsync(fh.fileno())
             os.replace(tmp, path)

@@ -163,6 +163,28 @@ DEF("writing_throttle_trigger_pct", 60, "int",
 DEF("writing_throttle_max_sleep_s", 0.05, "float",
     "per-write sleep ceiling of the memstore throttle ramp", _pos)
 
+# disk-pressure plane: per-surface byte budgets (0 = unlimited) +
+# read-only degradation (server/diskmgr.py)
+DEF("log_disk_limit_bytes", 0, "cap",
+    "per-tenant PALF WAL directory budget; crossing the utilization "
+    "threshold kicks checkpoint + WAL recycle, reaching the limit "
+    "drops the tenant to read-only (typed TenantReadOnly on writes, "
+    "reads keep serving) — ≙ log_disk_utilization_limit_threshold",
+    _nonneg)
+DEF("data_disk_limit_bytes", 0, "cap",
+    "per-tenant data directory (segments + manifest + slog) budget; "
+    "at the limit the tenant enters read-only until space frees",
+    _nonneg)
+DEF("spill_disk_limit_bytes", 0, "cap",
+    "per-tenant temp-file (spill) byte budget; exhaustion kills only "
+    "the spilling statement (typed SpillBudgetExceeded) — ≙ the "
+    "tmp-file quota", _nonneg)
+DEF("log_disk_utilization_threshold", 80, "int",
+    "percentage of log_disk_limit_bytes past which the tenant "
+    "reclaims aggressively (checkpoint + WAL recycle) before "
+    "degrading, and back under which read-only auto-exits",
+    lambda v: 1 <= v <= 100)
+
 # PX / distributed
 DEF("px_default_dop", 0, "int",
     "degree of parallelism (0 = mesh size)", _nonneg)

@@ -47,6 +47,10 @@ class Database:
         self.tenants: dict[str, Tenant] = {}
         self._session_ids = itertools.count(1)
         self.node_id = 0  # single-process instance (NodeDatabase overrides)
+        # disk-fault plane (net/faults.FaultPlane): a NodeServer arms
+        # its plane here so durable writers (backup, spill) consult it;
+        # None = no injection
+        self.faults = None
 
         # metrics plane on/off rides the config (ALTER SYSTEM SET
         # enable_metrics; scripts/metrics_bench.py prices the toggle)

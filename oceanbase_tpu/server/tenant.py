@@ -77,7 +77,8 @@ class Tenant:
             with qtrace.span("recovery.replay", tenant=name,
                              start_lsn=start, end_lsn=local.committed_lsn):
                 max_ts = self.tx.apply_replay(
-                    local.entries[start:local.committed_lsn], stats=stats)
+                    local.entries_between(start, local.committed_lsn),
+                    stats=stats)
             self.tx.gts.advance_to(max_ts)
         if stats.get("entries") or start or local.last_lsn():
             # a networked replica restores its log but cannot know the
@@ -170,6 +171,20 @@ class Tenant:
         self.tx.throttle = self.throttle
         self.engine.flush_listener = self.throttle.on_flush
 
+        # disk-pressure plane: per-surface byte budgets (log/data/spill)
+        # with read-only degradation; the log surface reclaims
+        # (aggressive checkpoint + WAL recycle) before it degrades.  The
+        # spill surface is accounted incrementally by TempFileStore, so
+        # it needs no walk paths.
+        from oceanbase_tpu.server.diskmgr import DiskManager
+
+        self.diskmgr = DiskManager(
+            self.config,
+            paths={"log": [wal_dir] if wal_dir else [],
+                   "data": [data_dir] if data_dir else []},
+            reclaim_cb=self.reclaim_log_disk)
+        self.tx.diskmgr = self.diskmgr
+
     def _pressure_flush(self, table: str):
         """Memstore-pressure flush: freeze + flush ``table`` at the
         PR-6 flush horizon (never past a live writer's snapshot) so
@@ -180,6 +195,15 @@ class Tenant:
             self.catalog.invalidate(table)
         except KeyError:
             self.throttle.drop_table(table)  # dropped mid-pressure
+
+    def reclaim_log_disk(self):
+        """Log-disk pressure reclaim: checkpoint aggressively, then
+        recycle the WAL prefix below the persisted replay point — the
+        checkpoint made those entries' effects durable in segments, so
+        boot replay never needs them again."""
+        self.checkpoint()
+        if hasattr(self.wal, "recycle"):
+            self.wal.recycle(int(self.engine.meta.get("wal_lsn", 0)))
 
     def kv(self, table: str):
         """OBKV-style table API handle (≙ src/libtable client)."""

@@ -53,6 +53,7 @@ class VirtualTables:
             "gv$sysstat_histogram": self.sysstat_histogram,
             "gv$memory": self.memory,
             "gv$tenant_resource": self.tenant_resource,
+            "gv$disk": self.disk,
             "v$session_history": self.session_history,
             "v$parameters": self.parameters,
             "v$tenants": self.tenants,
@@ -94,6 +95,30 @@ class VirtualTables:
                                 for r in recs], np.float64),
             "device_s": np.array([getattr(r, "device_s", 0.0)
                                   for r in recs], np.float64),
+        }
+
+    def disk(self):
+        """Disk-pressure plane per tenant surface (≙ the log-disk half
+        of gv$ob_units + __all_virtual_disk_stat): budgets, fresh
+        utilization, degradation state, plus one ``spill_stmt`` row per
+        statement actively spilling."""
+        rows = []
+        tenants = getattr(self.db, "tenants", {}) or {}
+        for name in sorted(tenants):
+            dm = getattr(tenants[name], "diskmgr", None)
+            if dm is not None:
+                rows.extend(dm.stats(tenant=name))
+        return {
+            "tenant": _obj(r["tenant"] for r in rows),
+            "surface": _obj(r["surface"] for r in rows),
+            "used_bytes": np.array([r["used_bytes"] for r in rows],
+                                   np.int64),
+            "limit_bytes": np.array([r["limit_bytes"] for r in rows],
+                                    np.int64),
+            "utilization_pct": np.array(
+                [r["utilization_pct"] for r in rows], np.float64),
+            "state": _obj(r["state"] for r in rows),
+            "detail": _obj(r["detail"] for r in rows),
         }
 
     def tenant_resource(self):

@@ -1861,7 +1861,11 @@ class Session:
             arrays, valids, dtypes, stats = spill_exec.execute_spilled(
                 plan, providers, sdir,
                 int(self.db.config["sql_work_area_rows"]),
-                device_tables, types_by_table, big)
+                device_tables, types_by_table, big,
+                disk_budget=getattr(self.tenant, "diskmgr", None),
+                faults=getattr(self.db, "faults", None),
+                label=(self._ash_state.get("sql", "")[:80]
+                       or f"session {self.session_id}"))
         except (NotDistributable, NotImplementedError):
             # unsupported shape OR a non-splittable aggregate
             # (count_distinct) — fall back to the in-memory engine

@@ -20,6 +20,7 @@ HBM-resident columns (≙ KV cache framework serving block cache hits).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 from dataclasses import dataclass
@@ -30,6 +31,8 @@ from oceanbase_tpu.catalog import Catalog, ColumnDef, TableDef
 from oceanbase_tpu.datatypes import SqlType, TypeKind
 from oceanbase_tpu.storage.segment import Segment
 from oceanbase_tpu.storage.tablet import Tablet
+
+log = logging.getLogger("oceanbase_tpu.storage.engine")
 
 
 @dataclass
@@ -150,6 +153,12 @@ class StorageEngine:
         self.truncate_barriers: dict[str, int] = {}
         self._lock = threading.RLock()
         self._slog_f = None
+        # segments installed in memory whose durable save (or slog
+        # publish) failed typed (DiskFull/DiskIOError): memory keeps
+        # serving them, and every flush/compact/checkpoint entry point
+        # re-attempts the persist FIRST — a manifest must never
+        # reference a segment file that does not exist on disk
+        self._pending_segs: list[tuple[str, object, dict]] = []
         # multi-node hook: logical DDL ops also replicate through the
         # tenant's log stream (net/node.py wires this; followers apply
         # via _replay) — physical segment ops stay node-local
@@ -180,11 +189,69 @@ class StorageEngine:
         # the EXACT serialized op string — replay verifies before apply
         # (≙ slog entry checksums)
         rec = json.dumps(op)
-        self._slog_f.write(json.dumps(
-            {"crc": crc64(rec.encode()), "rec": rec}) + "\n")
         self._slog_f.flush()
-        os.fsync(self._slog_f.fileno())
+        pre_off = os.path.getsize(self._slog_path())
+        try:
+            if self.faults is not None:
+                self.faults.check_write("slog", self._slog_path())
+            self._slog_f.write(json.dumps(
+                {"crc": crc64(rec.encode()), "rec": rec}) + "\n")
+            self._slog_f.flush()
+            os.fsync(self._slog_f.fileno())
+        except OSError as exc:
+            # crash-safe unwind: truncate the line back so the slog
+            # never carries a torn record (replay would reject it by
+            # crc, but the NEXT append would land mid-line)
+            self._unwind_slog(pre_off)
+            from oceanbase_tpu.server.diskmgr import wrap_disk_error
+
+            raise wrap_disk_error(exc, "slog append") from exc
         self._disk_fault("slog", self._slog_path())
+
+    def _unwind_slog(self, pre_off: int):
+        """Truncate the slog back to its pre-append offset after a
+        failed write (the buffered handle is poisoned — reopen)."""
+        try:
+            if self._slog_f is not None:
+                self._slog_f.close()
+        except OSError:
+            pass
+        self._slog_f = None
+        try:
+            with open(self._slog_path(), "a") as f:
+                f.truncate(pre_off)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            log.warning("slog unwind to offset %d failed", pre_off)
+
+    def _flush_pending_locked(self):
+        """Re-persist segments whose earlier save failed (disk
+        pressure): save is an idempotent overwrite, so a seg whose file
+        landed but whose slog record didn't simply saves again.  Raises
+        typed when the disk is still failing — the caller sheds."""
+        while self._pending_segs:
+            name, seg, op = self._pending_segs[0]
+            self._save_segment(name, seg)
+            self._log_meta(op)
+            self._pending_segs.pop(0)
+
+    def _persist_segs_locked(self, name: str, segs, make_op):
+        """Persist freshly minted in-memory segments; on a typed disk
+        failure the unsaved remainder parks in ``_pending_segs`` (the
+        next flush/compact/checkpoint re-attempts before anything else
+        trusts the segment list)."""
+        for i, (part, seg) in enumerate(segs):
+            op = make_op(part, seg, i)
+            try:
+                self._save_segment(name, seg)
+                self._log_meta(op)
+            except Exception:
+                self._pending_segs.append((name, seg, op))
+                for j, (p2, s2) in enumerate(segs[i + 1:], start=i + 1):
+                    self._pending_segs.append(
+                        (name, s2, make_op(p2, s2, j)))
+                raise
 
     def _disk_fault(self, kind: str, path: str):
         """Consult the disk-fault plane after a persistence write (no-op
@@ -198,6 +265,9 @@ class StorageEngine:
         if self.root is None:
             return
         with self._lock:
+            # a manifest must never reference a segment whose file is
+            # missing (an earlier save failed under disk pressure)
+            self._flush_pending_locked()
             m = {"tables": {}, "meta": self.meta}
             for name, ts in self.tables.items():
                 m["tables"][name] = {
@@ -225,16 +295,35 @@ class StorageEngine:
             # verifies before trusting the table/segment list
             inner = json.dumps(m, sort_keys=True)
             tmp = self._manifest_path() + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump({"crc": crc64(inner.encode()), "m": m}, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self._manifest_path())
+            try:
+                if self.faults is not None:
+                    self.faults.check_write("manifest",
+                                            self._manifest_path())
+                with open(tmp, "w") as f:
+                    json.dump({"crc": crc64(inner.encode()), "m": m}, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self._manifest_path())
+            except OSError as exc:
+                # the previous manifest generation is still intact (the
+                # tmp never published) — drop the partial tmp and raise
+                # typed so the checkpoint caller sheds, not crashes
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                from oceanbase_tpu.server.diskmgr import wrap_disk_error
+
+                raise wrap_disk_error(exc, "manifest checkpoint") from exc
             self._disk_fault("manifest", self._manifest_path())
             if self._slog_f:
                 self._slog_f.close()
                 self._slog_f = None
-            open(self._slog_path(), "w").close()
+            # reset (not recreate) the slog: append-mode + truncate keeps
+            # this an in-place recycle of an existing artifact rather
+            # than an unsynced create of a new generation
+            with open(self._slog_path(), "a") as f:
+                f.truncate(0)
 
     def _open_or_recover(self):
         mpath = self._manifest_path()
@@ -394,7 +483,21 @@ class StorageEngine:
         place segment bytes hit disk, so bitflip rules by kind cover
         every flush/compaction/load path)."""
         path = self._segment_file(table, seg.segment_id)
-        seg.save(path)
+        try:
+            if self.faults is not None:
+                self.faults.check_write("segment", path)
+            seg.save(path)
+        except OSError as exc:
+            # seg.save stages into path+".tmp" and publishes by rename:
+            # on failure the current generation (if any) is untouched —
+            # clean the partial tmp and surface the typed plane error
+            try:
+                os.remove(path + ".tmp")
+            except OSError:
+                pass
+            from oceanbase_tpu.server.diskmgr import wrap_disk_error
+
+            raise wrap_disk_error(exc, f"segment flush {table}") from exc
         self._disk_fault("segment", path)
         return path
 
@@ -426,16 +529,24 @@ class StorageEngine:
             tablet = Tablet(len(self.tables) + 1, columns, types, key_cols)
         self.tables[tdef.name] = TableStore(tdef, tablet)
         if log:
-            self._log_meta({
-                "op": "create_table", "name": tdef.name,
-                "columns": [[c.name, c.dtype.kind.value, c.dtype.precision,
-                             c.dtype.scale, c.nullable]
-                            for c in tdef.columns],
-                "primary_key": tdef.primary_key,
-                "partition": (list(tdef.partition)
-                              if tdef.partition else None),
-                "auto_increment": list(tdef.auto_increment_cols),
-            })
+            try:
+                self._log_meta({
+                    "op": "create_table", "name": tdef.name,
+                    "columns": [[c.name, c.dtype.kind.value,
+                                 c.dtype.precision,
+                                 c.dtype.scale, c.nullable]
+                                for c in tdef.columns],
+                    "primary_key": tdef.primary_key,
+                    "partition": (list(tdef.partition)
+                                  if tdef.partition else None),
+                    "auto_increment": list(tdef.auto_increment_cols),
+                })
+            except Exception:
+                # unwind the in-memory install: a table that never made
+                # the slog must not exist (it would vanish on restart —
+                # and block a retry of the same CREATE)
+                self.tables.pop(tdef.name, None)
+                raise
 
     def create_table(self, tdef: TableDef):
         with self._lock:
@@ -792,10 +903,16 @@ class StorageEngine:
                     pv or None, min_version=version, max_version=version)
                 ts.tablet.add_segment(seg, part_idx)
                 if self.root is not None:
-                    self._save_segment(name, seg)
-                    self._log_meta({"op": "add_segment", "table": name,
-                                    "segment_id": seg.segment_id,
-                                    "part": part_idx})
+                    op = {"op": "add_segment", "table": name,
+                          "segment_id": seg.segment_id, "part": part_idx}
+                    try:
+                        self._save_segment(name, seg)
+                        self._log_meta(op)
+                    except Exception:
+                        # memory serves the loaded seg; the persist
+                        # re-attempts at the next flush/checkpoint
+                        self._pending_segs.append((name, seg, op))
+                        raise
             ts.tdef.row_count = ts.tablet.row_count_estimate()
             # maintain secondary indexes: the loaded rows' index entries
             # load the same way (sorted baseline segment per index).
@@ -850,15 +967,16 @@ class StorageEngine:
 
         ERRSIM.hit("storage.flush")
         with self._lock:
+            self._flush_pending_locked()
             ts = self.tables[name]
             ts.tablet.freeze()
             segs = self._new_segs(ts.tablet.mini_compact(snapshot))
             if self.root is not None:
-                for part, seg in segs:
-                    self._save_segment(name, seg)
-                    self._log_meta({"op": "add_segment", "table": name,
-                                    "segment_id": seg.segment_id,
-                                    "part": part})
+                self._persist_segs_locked(
+                    name, segs,
+                    lambda part, seg, _i: {
+                        "op": "add_segment", "table": name,
+                        "segment_id": seg.segment_id, "part": part})
             tab = ts.tablet
             remaining = sum(
                 len(t.active) + sum(len(f) for f in t.frozen)
@@ -871,6 +989,7 @@ class StorageEngine:
 
     def _compact(self, name: str, level_filter, method: str):
         with self._lock:
+            self._flush_pending_locked()
             ts = self.tables[name]
             old_ids = [s.segment_id for s in ts.tablet.segments
                        if level_filter(s.level)]
@@ -880,14 +999,12 @@ class StorageEngine:
                 # partition that declined to compact keeps its segments
                 after = {s.segment_id for s in ts.tablet.segments}
                 removed = [i for i in old_ids if i not in after]
-                first = True
-                for part, seg in segs:
-                    self._save_segment(name, seg)
-                    self._log_meta({"op": "replace_segments", "table": name,
-                                    "segment_id": seg.segment_id,
-                                    "part": part,
-                                    "removed": removed if first else []})
-                    first = False
+                self._persist_segs_locked(
+                    name, segs,
+                    lambda part, seg, i: {
+                        "op": "replace_segments", "table": name,
+                        "segment_id": seg.segment_id, "part": part,
+                        "removed": removed if i == 0 else []})
             return segs[0][1] if segs else None
 
     def minor_compact(self, name: str):

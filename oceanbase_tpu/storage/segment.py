@@ -156,6 +156,11 @@ class Segment:
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             np.savez_compressed(f, **payload)
+            # fsync BEFORE the rename: without it a crash can publish
+            # the name with the bytes still in the page cache — a torn
+            # current-generation segment behind an "atomic" replace
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)  # atomic publish (≙ macro block seal)
 
     @staticmethod

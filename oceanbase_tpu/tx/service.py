@@ -108,6 +108,10 @@ class TransService:
         # OBKV — so accounting and the ramp/hard-limit gate live here;
         # None disables (bare unit use, WAL replay writes bypass write())
         self.throttle = None
+        # disk-pressure plane (server/diskmgr.DiskManager, wired by the
+        # tenant): the same choke point fails writes fast with typed
+        # TenantReadOnly while a disk budget is exhausted; None disables
+        self.diskmgr = None
         # StorageEngine for secondary-index maintenance (set by the
         # tenant wiring); None disables maintenance (e.g. bare unit use)
         self.engine = None
@@ -177,6 +181,11 @@ class TransService:
               op: str, values: dict):
         if tx.state != TxState.ACTIVE:
             raise TxAborted(f"tx {tx.tx_id} is {tx.state.value}")
+        if self.diskmgr is not None and not table.startswith("__idx__"):
+            # read-only degradation gate: fails fast (typed
+            # TenantReadOnly) while a disk budget is exhausted — reads
+            # never cross this point, so they keep serving
+            self.diskmgr.admit_write()
         if self.throttle is not None and not table.startswith("__idx__"):
             # BEFORE the append: ramped sleep past the trigger, typed
             # MemstoreFull at the hard limit (index maintenance rides

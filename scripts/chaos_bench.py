@@ -40,6 +40,18 @@ and the silent-corruption scenario closes the integrity loop:
                      which the slice must be bit-identical to an
                      independent sqlite oracle with 0 corrupt reads.
 
+and the disk-pressure scenario closes the budget loop:
+
+  disk_full_readonly   an unreachable log budget on the PALF leader:
+                     the reclaim round (aggressive checkpoint + WAL
+                     recycle) cannot satisfy it, so the tenant drops
+                     to read-only — typed errors only, weak reads
+                     oracle-identical — leadership moves to a peer
+                     with headroom (disk.takeover), lifting the
+                     budget auto-exits read-only, and an ENOSPC-failed
+                     WAL append + SIGKILL on the new leader restarts
+                     clean (the unwound append leaves no torn entry).
+
 Every query must return BIT-IDENTICAL rows to the fault-free baseline
 and finish inside the bench deadline (no query may ride a hung socket).
 Prints ONE dtl_bench-style JSON line: per-scenario parity, p99 latency,
@@ -692,6 +704,196 @@ def main():
             "admitted_oracle_parity": admitted_parity,
             "parity_mismatches": len(mismatches),
             "tenant_resource": [list(r) for r in tr]}
+
+        # ---- scenario 8: disk-full read-only + leader takeover -----
+        # fill the LEADER's log budget mid-workload (disk plane,
+        # server/diskmgr.py): the tenant must reclaim (aggressive
+        # checkpoint + WAL recycle), then degrade to READ-ONLY —
+        # typed errors only, zero hangs, weak reads stay
+        # oracle-identical — hand leadership to a peer with headroom
+        # (disk.takeover), auto-exit once the budget lifts, and a
+        # subsequent ENOSPC-failed WAL append + SIGKILL on the new
+        # leader must restart clean (the unwound append never leaves
+        # a torn entry for replay to trip on)
+        t0 = time.monotonic()
+        start_node(3)  # dead since the overload storm
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if clients[3].ping():
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("node 3 never came back for scenario 8")
+        if not wait_detector(c1, 3, ("up",), timeout=30):
+            raise TimeoutError("detector never flipped node 3 up")
+        n_now = int(rows_of(sql("select count(*) from lineitem"))[0][0])
+        wait_converged(clients, "lineitem", n_now)
+
+        def leader_id():
+            for i, cli in clients.items():
+                try:
+                    if cli.call("node.state")["role"] == "leader":
+                        return i
+                except Exception:  # noqa: BLE001 — node may be down
+                    pass
+            return 0
+
+        deadline = time.time() + 30
+        lead = 0
+        while time.time() < deadline and not lead:
+            lead = leader_id()
+            if not lead:
+                time.sleep(0.3)
+        assert lead, "no leader before the disk-full scenario"
+
+        def gv_disk_state(i):
+            r = rows_of(clients[i].call(
+                "sql.execute", sql="select surface, state from gv$disk"
+                " where surface = 'log'", consistency="weak"))
+            return r[0][1] if r else ""
+
+        # 16 bytes is below even the post-recycle WAL floor: the
+        # reclaim round runs (and shrinks the log) but CANNOT satisfy
+        # the budget, so the tenant must degrade instead of flapping;
+        # config.set force-polls, so the reply tells us the outcome
+        st = clients[lead].call("config.set",
+                                name="log_disk_limit_bytes", value=16)
+        entered_ro = bool(st.get("read_only"))
+        ro_state = gv_disk_state(lead)
+        ro_reads = {k: _round_rows(rows_of(clients[lead].call(
+            "sql.execute", sql=q, consistency="weak")))
+            for k, q in QUERIES.items()}
+        ro_parity = ro_reads == oracle
+
+        # write probes pointed AT the degraded node: every failure
+        # must be a typed disk/routing error (never a hang, never a
+        # bare OSError), and once leadership lands on a peer with
+        # headroom the same probes succeed via forwarding — the
+        # cluster keeps accepting writes with one disk full
+        disk_ok_kinds = {"TenantReadOnly", "NotLeader", "NoQuorum",
+                         "DeadlineExceeded", "ConnectionError",
+                         "TimeoutError"}
+        probe_kinds: dict = {}
+        probe_hung = landed = 0
+        new_lead = 0
+        k0 = n_now + 100
+        deadline = time.monotonic() + QUERY_DEADLINE_S
+        while time.monotonic() < deadline:
+            t1 = time.monotonic()
+            kind = "ok"
+            try:
+                clients[lead].call(
+                    "sql.execute",
+                    sql=f"insert into lineitem values ({k0}, 1, 1, 1,"
+                        f" 10200, 0, 0)")
+            except Exception as e:  # noqa: BLE001 — triaged below
+                kind = getattr(e, "kind", type(e).__name__)
+            if time.monotonic() - t1 > QUERY_DEADLINE_S:
+                probe_hung += 1
+            probe_kinds[kind] = probe_kinds.get(kind, 0) + 1
+            k0 += 1
+            if kind == "ok":
+                landed += 1
+                new_lead = leader_id()
+                if new_lead and new_lead != lead:
+                    break
+            time.sleep(0.1)
+        untyped_disk = {k: v for k, v in probe_kinds.items()
+                        if k != "ok" and k not in disk_ok_kinds}
+        took_over = bool(new_lead and new_lead != lead)
+        peer_headroom = (gv_disk_state(new_lead) == "ok"
+                         if took_over else False)
+
+        # space returns: lifting the budget auto-exits read-only at
+        # the very next poll (config.set forces one)
+        st2 = clients[lead].call("config.set",
+                                 name="log_disk_limit_bytes", value=0)
+        auto_exit = (not st2.get("read_only")
+                     and gv_disk_state(lead) == "ok")
+        from oceanbase_tpu.server import metrics as qmetrics
+
+        flat = qmetrics.wire_to_flat(
+            clients[lead].call("metrics.scrape")["wire"])
+        reclaims = sum(int(v) for k, v in flat.items()
+                       if k.startswith("disk.reclaims")
+                       and isinstance(v, (int, float)))
+        ro_exits = sum(int(v) for k, v in flat.items()
+                       if k.startswith("disk.readonly_exits")
+                       and isinstance(v, (int, float)))
+
+        # ENOSPC-failed WAL append + SIGKILL on the (new) leader:
+        # the append must fail TYPED with nothing committed, and the
+        # restarted node must replay clean and reach parity
+        m = new_lead if took_over else lead
+        clients[m].call("config.set", name="enable_disk_faults",
+                        value=True)
+        clients[m].call("fault.inject", where="disk", action="enospc",
+                        verb="wal", count=1)
+        pre = int(rows_of(clients[m].call(
+            "sql.execute", sql="select count(*) from lineitem",
+            consistency="weak"))[0][0])
+        enospc_kind = "ok"
+        try:
+            clients[m].call(
+                "sql.execute",
+                sql=f"insert into lineitem values ({k0}, 1, 1, 1,"
+                    f" 10200, 0, 0)")
+        except Exception as e:  # noqa: BLE001 — triaged
+            enospc_kind = getattr(e, "kind", type(e).__name__)
+        post = int(rows_of(clients[m].call(
+            "sql.execute", sql="select count(*) from lineitem",
+            consistency="weak"))[0][0])
+        count_held = post == pre
+        procs[m].send_signal(signal.SIGKILL)
+        procs[m].wait(timeout=10)
+        t1 = time.monotonic()
+        start_node(m)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if clients[m].ping():
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError(f"node {m} never came back from ENOSPC")
+        watch = clients[min(i for i in clients if i != m)]
+        if not wait_detector(watch, m, ("up",), timeout=30):
+            raise TimeoutError(f"detector never flipped node {m} up")
+        restart_s = time.monotonic() - t1
+        sql(f"insert into lineitem values ({k0 + 1}, 1, 1, 1,"
+            " 10200, 0, 0)")  # writes resume post-recovery
+        cnt = int(rows_of(sql("select count(*) from lineitem"))[0][0])
+        wait_converged(clients, "lineitem", cnt)
+        served_m = _round_rows(rows_of(clients[m].call(
+            "sql.execute", sql=QUERIES["q6"], consistency="weak")))
+        parity, lat, hung = run_queries(sql, baseline, repeats=3)
+        out["scenarios"]["disk_full_readonly"] = {
+            "parity": bool(entered_ro and ro_state == "readonly"
+                           and ro_parity and not untyped_disk
+                           and landed > 0 and took_over
+                           and peer_headroom and auto_exit
+                           and reclaims >= 1 and ro_exits >= 1
+                           and enospc_kind == "DiskFull"
+                           and count_held
+                           and served_m == oracle["q6"]
+                           and parity and probe_hung == 0),
+            "p99_s": round(p99(lat), 3),
+            "queries": (len(lat) + sum(probe_kinds.values())
+                        + len(QUERIES) + 1),
+            "hung": hung + probe_hung,
+            "old_leader": lead, "new_leader": new_lead,
+            "entered_readonly": entered_ro,
+            "readonly_state": ro_state,
+            "readonly_reads_parity": ro_parity,
+            "probe_kinds": probe_kinds,
+            "untyped_errors": untyped_disk,
+            "writes_landed_via_peer": landed,
+            "takeover": took_over, "peer_headroom": peer_headroom,
+            "auto_exit": auto_exit, "reclaims": reclaims,
+            "readonly_exits": ro_exits,
+            "enospc_kind": enospc_kind, "count_held": count_held,
+            "restart_s": round(restart_s, 2),
+            "served_by_restarted_node": served_m == oracle["q6"],
+            "round_trip_s": round(time.monotonic() - t0, 2)}
 
         out["parity_all"] = all(s["parity"]
                                 for s in out["scenarios"].values())

@@ -323,29 +323,38 @@ def test_unlocked_mutation_detected_and_pragma():
 # ---------------------------------------------------------------------------
 
 IO_BAD = '''
+import os
+
 def save_blob(path, payload):
-    with open(path, "wb") as f:
+    with open(path + ".tmp", "wb") as f:
         f.write(payload)
+    os.replace(path + ".tmp", path)
 '''
 
 IO_CLEAN = '''
+import os
+
 from oceanbase_tpu.native import crc64
 
 def save_blob(path, payload):
     digest = crc64(payload)
-    with open(path, "wb") as f:
+    with open(path + ".tmp", "wb") as f:
         f.write(payload + digest.to_bytes(8, "little"))
+    os.replace(path + ".tmp", path)
 '''
 
 IO_CLEAN_TRANSITIVE = '''
+import os
+
 from oceanbase_tpu.native import crc64
 
 def _stamp(payload):
     return payload + crc64(payload).to_bytes(8, "little")
 
 def save_blob(path, payload):
-    with open(path, "wb") as f:
+    with open(path + ".tmp", "wb") as f:
         f.write(_stamp(payload))
+    os.replace(path + ".tmp", path)
 '''
 
 
@@ -366,8 +375,9 @@ def test_io_clean_direct_and_transitive():
 
 def test_io_pragma_and_registry():
     sup = IO_BAD.replace(
-        '    with open(path, "wb") as f:',
-        '    with open(path, "wb") as f:  # obcheck: ok(io.unverified-write)')
+        '    with open(path + ".tmp", "wb") as f:',
+        '    with open(path + ".tmp", "wb") as f:'
+        '  # obcheck: ok(io.unverified-write)')
     fs = {"oceanbase_tpu/storage/blob.py": sup}
     assert run_all(fs, [check_io_rules]) == []
     # a registered exemption silences the write without a pragma
@@ -386,6 +396,95 @@ def test_io_registry_hygiene():
     found = run_all(fs, [lambda az: check_io_rules(az, exempt)])
     assert _rules(found) == ["io.unregistered-exemption"]
     assert len(found) == 2  # one stale, one unknown
+
+
+# ---------------------------------------------------------------------------
+# io.inplace-durable-write (stage-then-publish discipline)
+# ---------------------------------------------------------------------------
+
+INPLACE_BAD = '''
+from oceanbase_tpu.native import crc64
+
+def save_meta(path, payload):
+    with open(path, "w") as f:
+        f.write(payload + str(crc64(payload.encode())))
+'''
+
+INPLACE_STAGED = '''
+import os
+
+from oceanbase_tpu.native import crc64
+
+def save_meta(path, payload):
+    with open(path + ".tmp", "w") as f:
+        f.write(payload + str(crc64(payload.encode())))
+    os.replace(path + ".tmp", path)
+'''
+
+INPLACE_APPEND = '''
+from oceanbase_tpu.native import crc64
+
+def append_meta(path, payload):
+    with open(path, "a") as f:
+        f.write(payload + str(crc64(payload.encode())))
+'''
+
+
+def test_inplace_catches_direct_write():
+    """A create-mode open on the final path (even digest-protected,
+    even text mode) is a torn-artifact risk; staging via *.tmp +
+    os.replace or appending is the discipline."""
+    fs = {"oceanbase_tpu/storage/meta.py": INPLACE_BAD}
+    found = run_all(fs, [check_io_rules])
+    assert _rules(found) == ["io.inplace-durable-write"]
+    # outside the durable surface: not under contract
+    fs = {"oceanbase_tpu/exec/meta.py": INPLACE_BAD}
+    assert run_all(fs, [check_io_rules]) == []
+
+
+def test_inplace_clean_staged_and_append():
+    for src in (INPLACE_STAGED, INPLACE_APPEND):
+        fs = {"oceanbase_tpu/storage/meta.py": src}
+        assert run_all(fs, [check_io_rules]) == []
+
+
+def test_inplace_pragma_and_registry():
+    sup = INPLACE_BAD.replace(
+        '    with open(path, "w") as f:',
+        '    with open(path, "w") as f:'
+        '  # obcheck: ok(io.inplace-durable-write)')
+    fs = {"oceanbase_tpu/storage/meta.py": sup}
+    assert run_all(fs, [check_io_rules]) == []
+    ie = {"oceanbase_tpu/storage/meta.py": {"save_meta": "verified"}}
+    fs = {"oceanbase_tpu/storage/meta.py": INPLACE_BAD}
+    assert run_all(
+        fs, [lambda az: check_io_rules(az, inplace_exempt=ie)]) == []
+
+
+def test_inplace_registry_hygiene():
+    """Unknown and stale INPLACE_EXEMPT entries are findings too."""
+    ie = {"oceanbase_tpu/storage/meta.py": {
+        "save_meta": "stale: now staged",
+        "ghost_fn": "gone"}}
+    fs = {"oceanbase_tpu/storage/meta.py": INPLACE_STAGED}
+    found = run_all(
+        fs, [lambda az: check_io_rules(az, inplace_exempt=ie)])
+    assert _rules(found) == ["io.unregistered-exemption"]
+    assert len(found) == 2  # one stale, one unknown
+
+
+def test_inplace_real_repo_baseline_empty():
+    """The real repo carries no in-place durable writes: every site is
+    staged, appended, or audited in INPLACE_EXEMPT."""
+    import subprocess
+
+    script = os.path.join(REPO, "scripts", "obcheck.py")
+    r = subprocess.run(
+        [sys.executable, script, "--json", "--family", "io"],
+        capture_output=True, text=True, cwd=REPO)
+    summary = json.loads(r.stdout.splitlines()[0])
+    assert summary["by_rule"].get("io.inplace-durable-write", 0) == 0
+    assert summary["by_rule"].get("io.unregistered-exemption", 0) == 0
 
 
 # ---------------------------------------------------------------------------
@@ -568,8 +667,9 @@ def test_new_families_baseline_round_trip(tmp_path):
     assert diff_findings(first, base) == []
     fs["oceanbase_tpu/storage/blob.py"] = IO_BAD + (
         '\ndef save_other(path, b):\n'
-        '    with open(path, "wb") as f:\n'
-        '        f.write(b)\n')
+        '    with open(path + ".tmp", "wb") as f:\n'
+        '        f.write(b)\n'
+        '    os.replace(path + ".tmp", path)\n')
     second = run_all(fs, [check_cancel_rules, check_io_rules])
     new = diff_findings(second, base)
     assert len(new) == 1 and new[0].func == "save_other"
