@@ -70,6 +70,12 @@ qmetrics.declare("plan.device_s", "histogram",
                  "device half of the execution split: "
                  "block_until_ready() bracketed at the result boundary "
                  "(the denominator of achieved_gflops)", unit="s")
+qmetrics.declare("plan.sidecar_builds", "counter",
+                 "index-probe sidecar rebuilds (argsort + pad) paid "
+                 "because no cached sidecar matched the relation version")
+qmetrics.declare("plan.sidecar_build_s", "histogram",
+                 "wall time of one sidecar rebuild inside "
+                 "prepare_index_probes", unit="s")
 
 
 # ---------------------------------------------------------------------------
@@ -99,6 +105,10 @@ class PlanCacheEntry:
     executions: int = 0       # execute_plan calls for this fingerprint
     xla_traces: int = 0       # trace (compile) events across all shapes
     last_compile_s: float = 0.0  # wall time of the last lower+compile
+    last_lower_s: float = 0.0    # the Python-lowering share of it
+    sidecar_builds: int = 0   # index-probe sidecar rebuilds for plans
+    #                         # sharing this fingerprint
+    sidecar_build_s: float = 0.0  # summed wall time of those rebuilds
     flops: float = 0.0        # cost_analysis flops (last compile)
     bytes_accessed: float = 0.0  # cost_analysis bytes (last compile)
     peak_memory: int = 0      # memory_analysis arg+temp+output bytes
@@ -661,6 +671,11 @@ def prepare_index_probes(catalog, plan: PlanNode,
         if hit is not None and hit[0] == id(rel):
             tables[sname] = hit[1]
             continue
+        # cache miss: the rebuild below re-pays the argsort + pad every
+        # hash join amortizes away — ROADMAP #1's per-session churn —
+        # so it is timed into the statement's sidecar_build_s phase and
+        # counted per plan fingerprint (gv$plan_cache.sidecar_builds)
+        tb = time.perf_counter()
         td = catalog.table_def(node.table)
         ix = next(i for i in td.indexes if i.name == node.index)
         base_col = ix.columns[0]
@@ -691,6 +706,13 @@ def prepare_index_probes(catalog, plan: PlanNode,
             mask=None)
         cache[ckey] = (id(rel), sidecar, rel)
         tables[sname] = sidecar
+        dt = time.perf_counter() - tb
+        st = _stats_for(plan.fingerprint())
+        st.sidecar_builds += 1
+        st.sidecar_build_s += dt
+        qmetrics.inc("plan.sidecar_builds", table=node.table)
+        qmetrics.observe("plan.sidecar_build_s", dt, table=node.table)
+        add_exec_times(sidecar_build_s=dt)
 
 
 def _input_signature(tables: dict[str, Relation]) -> tuple:
@@ -812,13 +834,22 @@ class _PlanExecutable:
         self._lock = threading.Lock()
 
     def _compile(self, tables, sig):
+        # two windows, one total: lower() is the Python tracing half
+        # (plan walk + jaxpr build), compile() the XLA backend half —
+        # the time model attributes them separately (lower_s/compile_s)
+        # while last_compile_s stays their sum for the existing
+        # gv$plan_cache column and the dispatch subtraction below
         t0 = time.perf_counter()
-        exe = self._run.lower(tables).compile()
-        dt = time.perf_counter() - t0
+        lowered = self._run.lower(tables)
+        t1 = time.perf_counter()
+        exe = lowered.compile()
+        t2 = time.perf_counter()
+        dt = t2 - t0
         flops, nbytes, peak = _xla_analysis(exe)
         st = self.stats
         st.xla_traces += 1
         st.last_compile_s = dt
+        st.last_lower_s = t1 - t0
         st.flops = flops
         st.bytes_accessed = nbytes
         st.peak_memory = peak
@@ -904,13 +935,48 @@ class ExecTimes:
     remote DTL fragments folded in via ``add_exec_times``.  ``flops`` /
     ``bytes`` are the XLA cost_analysis totals of the executed programs
     — the numerators the roofline prediction prices against ``calls``
-    launches of measured ``device_s``."""
+    launches of measured ``device_s``.
+
+    The named phases decompose the host half (the gv$time_model rows):
+    ``bind_s`` parse/optimize/bind (session-recorded), ``sidecar_build_s``
+    index-probe sidecar rebuilds, ``lower_s``/``compile_s`` the two
+    windows of a fresh XLA trace, ``dispatch_s`` the per-execution host
+    time until the runtime hands back futures, ``merge_s`` the DTL
+    coordinator's fragment concatenation.  ``host_s`` stays the legacy
+    aggregate (local dispatch + remote fragments' host halves), so
+    phase sums and the aggregate are reconciled by workload_bench, not
+    assumed equal."""
 
     host_s: float = 0.0
     device_s: float = 0.0
     flops: float = 0.0
     bytes: float = 0.0
     calls: int = 0
+    bind_s: float = 0.0
+    sidecar_build_s: float = 0.0
+    lower_s: float = 0.0
+    compile_s: float = 0.0
+    dispatch_s: float = 0.0
+    merge_s: float = 0.0
+
+    #: the host-phase decomposition, in pipeline order (shared by
+    #: gv$sql_audit columns, gv$time_model rows and the report builder)
+    PHASES = ("bind_s", "sidecar_build_s", "lower_s", "compile_s",
+              "dispatch_s", "merge_s")
+
+    def phase_sum(self) -> float:
+        """Sum of the named host phases + device_s — what the
+        time-model-sums-to-wall reconciliation compares against the
+        audited statement elapsed."""
+        return (self.bind_s + self.sidecar_build_s + self.lower_s
+                + self.compile_s + self.dispatch_s + self.merge_s
+                + self.device_s)
+
+    def worst_phase(self) -> tuple[str, float]:
+        """(phase name, seconds) of the dominant host phase — the
+        EXPLAIN ANALYZE roofline callout."""
+        name = max(self.PHASES, key=lambda p: getattr(self, p))
+        return name, getattr(self, name)
 
 
 def _exec_acc() -> ExecTimes:
@@ -930,21 +996,35 @@ def exec_times() -> ExecTimes:
     """Snapshot of this thread's statement-scoped accumulator."""
     acc = _exec_acc()
     return ExecTimes(acc.host_s, acc.device_s, acc.flops, acc.bytes,
-                     acc.calls)
+                     acc.calls, acc.bind_s, acc.sidecar_build_s,
+                     acc.lower_s, acc.compile_s, acc.dispatch_s,
+                     acc.merge_s)
 
 
 def add_exec_times(host_s: float = 0.0, device_s: float = 0.0,
                    flops: float = 0.0, bytes: float = 0.0,  # noqa: A002
-                   calls: int = 0):
+                   calls: int = 0, bind_s: float = 0.0,
+                   sidecar_build_s: float = 0.0, lower_s: float = 0.0,
+                   compile_s: float = 0.0, dispatch_s: float = 0.0,
+                   merge_s: float = 0.0):
     """Fold externally measured work into the statement accumulator —
     DTL coordinators merge the split their remote fragments shipped
-    back, so a pushed-down statement's device_s covers the cluster."""
+    back, so a pushed-down statement's device_s covers the cluster.
+    The phase kwargs feed the time-model decomposition (the session
+    records bind_s, prepare_index_probes sidecar_build_s, the DTL
+    coordinator merge_s)."""
     acc = _exec_acc()
     acc.host_s += float(host_s)
     acc.device_s += float(device_s)
     acc.flops += float(flops)
     acc.bytes += float(bytes)
     acc.calls += int(calls)
+    acc.bind_s += float(bind_s)
+    acc.sidecar_build_s += float(sidecar_build_s)
+    acc.lower_s += float(lower_s)
+    acc.compile_s += float(compile_s)
+    acc.dispatch_s += float(dispatch_s)
+    acc.merge_s += float(merge_s)
 
 
 @functools.lru_cache(maxsize=256)
@@ -1065,6 +1145,14 @@ def execute_plan(plan: PlanNode, tables: dict[str, Relation],
         acc.flops += flops
         acc.bytes += nbytes
         acc.calls += 1
+        # time-model phases: host_s already has the compile window
+        # subtracted above, so it IS the dispatch phase; a fresh trace
+        # additionally books its two compile windows
+        acc.dispatch_s += host_s
+        if compiled_now:
+            acc.lower_s += stats.last_lower_s
+            acc.compile_s += max(
+                stats.last_compile_s - stats.last_lower_s, 0.0)
         plan_elapsed = time.perf_counter() - t0
         qmetrics.inc("plan.executions", op=root_op)
         qmetrics.observe("plan.execute_s", plan_elapsed, op=root_op)

@@ -77,7 +77,8 @@ DISK_ERRNO_ACTIONS = ("enospc", "eio", "partial")
 
 #: artifact kinds the persistence boundaries report to ``act_disk`` /
 #: ``check_write`` (rule.verb matches against these; None = any)
-DISK_KINDS = ("segment", "manifest", "slog", "wal", "spill", "backup")
+DISK_KINDS = ("segment", "manifest", "slog", "wal", "spill", "backup",
+              "workload")
 
 
 class FaultDrop(ConnectionError):

@@ -60,6 +60,7 @@ class NodeDatabase:
             PlanHistory,
             PlanMonitor,
             SqlAudit,
+            TimeModel,
             WaitEvents,
         )
         from oceanbase_tpu.server.trace import TraceRegistry
@@ -78,6 +79,7 @@ class NodeDatabase:
             int(self.config["plan_history_entries"]))
         self.audit = SqlAudit(int(self.config["sql_audit_queue_size"]))
         self.wait_events = WaitEvents()
+        self.time_model = TimeModel()  # gv$time_model (phase split)
         # ASH + full-link trace ring: NodeServer.start()/stop() drive
         # the sampler lifecycle; sessions register their state slots in
         # Session.__init__ like they do against a plain Database
@@ -100,6 +102,12 @@ class NodeDatabase:
                 self.config["admission_tenant_weight"]))
         self.virtual_tables = VirtualTables(self)
         self._session_ids = itertools.count(1)
+        # workload diagnostics repository: NodeServer installs the
+        # fault plane on self.faults first, then start() launches the
+        # snapshot thread beside scrub/hb/ckpt
+        from oceanbase_tpu.server.workload import WorkloadRepository
+
+        self.workload = WorkloadRepository(self, root)
 
     @property
     def tx(self):
@@ -251,6 +259,7 @@ class NodeServer:
             "cluster.health": self._h_health,
             "recovery.state": self._h_recovery,
             "metrics.scrape": self._h_metrics,
+            "workload.snapshot": self._h_workload_snapshot,
             "fault.inject": self._h_fault_inject,
             "fault.clear": self._h_fault_clear,
             "config.set": self._h_config_set,
@@ -328,6 +337,19 @@ class NodeServer:
                     "text": qmetrics.prom_text()}
         return {"node_id": self.node_id,
                 "wire": qmetrics.wire_snapshot()}
+
+    def _h_workload_snapshot(self):
+        """This node's LOCAL workload-diagnostics payload (the wire
+        face of the snapshot merge): a pure read of monotonic counters
+        plus point-in-time state, digest-stamped so the merging
+        coordinator can verify the bulk body before folding it in."""
+        from oceanbase_tpu.server.workload import canonical_bytes
+        from oceanbase_tpu.storage.integrity import bytes_crc
+
+        payload = self.db.workload.collect()
+        return {"node_id": self.node_id,
+                "payload": payload,
+                "crc": bytes_crc(canonical_bytes(payload))}
 
     def _h_recovery(self):
         """Recovery progress (the wire face of gv$recovery): boot
@@ -757,6 +779,10 @@ class NodeServer:
         self.health.start()
         if bool(self.config["enable_ash"]):
             self.db.ash.start()
+        # workload snapshot thread: always launched (the loop gates on
+        # enable_workload_repo every round, so ALTER SYSTEM turns it
+        # on/off without a restart)
+        self.db.workload.start()
         if self._bootstrap:
             threading.Thread(target=self._bootstrap_elect,
                              daemon=True).start()
@@ -826,6 +852,7 @@ class NodeServer:
 
     def stop(self):
         self._stop.set()
+        self.db.workload.stop()
         self.db.ash.stop()
         self.health.stop()
         self.server.stop()

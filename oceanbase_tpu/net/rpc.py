@@ -166,6 +166,12 @@ POLICIES: dict[str, VerbPolicy] = {
     # an already-set flag is a no-op, trivially idempotent; it must
     # fail FAST (the canceller is usually unwinding a kill/timeout)
     "dtl.cancel":   VerbPolicy(2.0, True, 2, 0.02, 0.20),
+    # workload.snapshot is a pure read of the node's diagnostic
+    # surfaces (monotonic counters + point-in-time state) — re-asking
+    # returns a superset-or-equal payload, trivially idempotent like
+    # metrics.scrape; the deadline is wider because the payload spans
+    # every surface, not one registry
+    "workload.snapshot": VerbPolicy(10.0, True, 2, 0.05, 0.50),
     "sql.execute":  VerbPolicy(600.0, False),
 }
 

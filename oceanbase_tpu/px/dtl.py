@@ -961,7 +961,12 @@ class DtlExchange:
                 return None
             merge_mon = [] if monitor is not None else None
             with qtrace.span("dtl.merge", parts=nparts):
+                # merge_s covers ONLY the host-side concatenation: the
+                # final-merge execute_plan books its own dispatch/device
+                # time through the accumulator like any other execution
+                mm0 = time.monotonic()
                 rel = merge_fragments(results)
+                pp.add_exec_times(merge_s=time.monotonic() - mm0)
                 out = execute_plan(push.rebuilt, {DTL_TABLE: rel},
                                    monitor_out=merge_mon,
                                    monitor_collect=collect)

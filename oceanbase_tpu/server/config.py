@@ -349,6 +349,24 @@ DEF("auto_compact_interval_s", 3600.0, "float",
 DEF("lock_wait_timeout_s", 5.0, "float",
     "implicit DML table-lock wait budget (≙ lock_wait_timeout)", _pos)
 
+# workload diagnostics repository (server/workload.py) — persistent
+# crc64-stamped snapshots of the observability surfaces, the substrate
+# of ANALYZE WORKLOAD REPORT (≙ AWR-style workload repository).  All
+# four knobs hot-reload: the snapshot loop re-reads them every round.
+DEF("enable_workload_repo", False, "bool",
+    "background workload-snapshot thread: periodically persist "
+    "gv$sysstat + histograms, gv$time_model, plan-cache/plan-history "
+    "summaries, ASH rollups and disk/health state to "
+    "<data_dir>/workload/ (crc64-verified on load, quarantined on "
+    "mismatch per the PR 9 integrity contract)")
+DEF("workload_snapshot_interval_s", 60.0, "float",
+    "cadence of automatic workload snapshots", _pos)
+DEF("workload_retention_keep", 64, "int",
+    "newest snapshots retained per node; older ones are pruned "
+    "(count cap, mirrors integrity.prune_quarantine)", _pos)
+DEF("workload_retention_max_age_s", 7 * 24 * 3600.0, "float",
+    "snapshots older than this are pruned regardless of count", _pos)
+
 
 class Config:
     """One configuration instance (cluster-level or tenant overlay)."""

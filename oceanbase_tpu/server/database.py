@@ -29,6 +29,7 @@ from oceanbase_tpu.server.monitor import (
     PlanMonitor,
     SqlAudit,
     TimeCalibration,
+    TimeModel,
     WaitEvents,
 )
 from oceanbase_tpu.server.tenant import Tenant
@@ -106,6 +107,9 @@ class Database:
 
         self.time_calibration = TimeCalibration()
         self.device_profiles = DeviceProfileStore()
+        # per-tenant time-model accounting (gv$time_model): every
+        # statement folds its host-phase split + device/queue/wall here
+        self.time_model = TimeModel()
         # full-link trace ring (gv$trace / SHOW TRACE; server/trace.py)
         self.trace_registry = TraceRegistry(
             int(self.config["trace_ring_spans"]))
@@ -125,6 +129,19 @@ class Database:
         self.virtual_tables = VirtualTables(self)
         if start_ash and self.config["enable_ash"]:
             self.ash.start()
+        # workload diagnostics repository (server/workload.py):
+        # persistent snapshots + ANALYZE WORKLOAD REPORT.  The snapshot
+        # thread starts with the knob (or later, when ALTER SYSTEM
+        # turns it on — the watcher below); the loop re-reads both
+        # knobs every round, so turning it OFF needs no restart.
+        from oceanbase_tpu.server.workload import WorkloadRepository
+
+        self.workload = WorkloadRepository(self, root)
+        if bool(self.config["enable_workload_repo"]):
+            self.workload.start()
+        self.config.watch(
+            lambda k, v: self.workload.start()
+            if k == "enable_workload_repo" and bool(v) else None)
         # DBMS job scheduler (≙ dbms_job/dbms_scheduler); built-ins
         # register at boot, the thread starts on demand or when enabled
         from oceanbase_tpu.server.jobs import JobScheduler
@@ -294,5 +311,7 @@ class Database:
     def close(self):
         self.ash.stop()
         self.jobs.stop()
+        if getattr(self, "workload", None) is not None:
+            self.workload.stop()
         for t in self.tenants.values():
             t.close()

@@ -50,6 +50,16 @@ class AuditRecord:
     # stalls vs device work, separable in slow-statement triage
     host_s: float = 0.0
     device_s: float = 0.0
+    # named host-phase decomposition (exec/plan.py::ExecTimes.PHASES):
+    # where the host half of the wall clock went for THIS statement
+    bind_s: float = 0.0
+    sidecar_build_s: float = 0.0
+    lower_s: float = 0.0
+    xla_compile_s: float = 0.0   # ExecTimes.compile_s; ``compile_s``
+    #                            # above predates the split and keeps
+    #                            # its legacy bind-window meaning
+    dispatch_s: float = 0.0
+    merge_s: float = 0.0
 
 
 class SqlAudit:
@@ -547,6 +557,69 @@ class WaitEvents:
 
         with self._lock:
             return {e: hist_stats(h) for e, h in self._hists.items()}
+
+
+class TimeModel:
+    """Per-tenant accumulated time decomposition (≙ gv$time_model).
+
+    Every statement folds its ExecTimes host-phase split (exec/plan.py:
+    bind / sidecar build / lower / compile / dispatch / merge) plus the
+    device half, queue wait and measured wall into one running account
+    per tenant, so "where did the wall clock go" is answerable by SQL
+    without replaying the audit ring.  ``rows()`` is the virtual-table
+    shape; ``snapshot()`` is the workload-repository payload shape.
+    """
+
+    #: pipeline-ordered phase names; ``elapsed_s`` is appended as its
+    #: own row so phase-sum-vs-wall reconciliation is a single query
+    PHASES = ("queue_s", "bind_s", "sidecar_build_s", "lower_s",
+              "compile_s", "dispatch_s", "merge_s", "device_s")
+
+    def __init__(self):
+        self._tenants: dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, tenant: str, times, elapsed_s: float = 0.0,
+                queue_s: float = 0.0):
+        """Fold one statement's ExecTimes into the tenant account."""
+        with self._lock:
+            acc = self._tenants.get(tenant)
+            if acc is None:
+                acc = self._tenants[tenant] = {p: 0.0 for p in self.PHASES}
+                acc["elapsed_s"] = 0.0
+                acc["statements"] = 0
+            for phase in self.PHASES:
+                if phase == "queue_s":
+                    continue
+                acc[phase] += float(getattr(times, phase, 0.0) or 0.0)
+            acc["queue_s"] += float(queue_s)
+            acc["elapsed_s"] += float(elapsed_s)
+            acc["statements"] += 1
+
+    def rows(self) -> list:
+        """gv$time_model rows: one per (tenant, phase)."""
+        out = []
+        with self._lock:
+            for tenant in sorted(self._tenants):
+                acc = self._tenants[tenant]
+                wall = acc["elapsed_s"]
+                for phase in self.PHASES + ("elapsed_s",):
+                    sec = acc[phase]
+                    out.append({
+                        "tenant": tenant,
+                        "phase": phase,
+                        "seconds": round(sec, 6),
+                        "pct_of_elapsed": (round(100.0 * sec / wall, 2)
+                                           if wall > 0 else 0.0),
+                        "statements": acc["statements"],
+                    })
+        return out
+
+    def snapshot(self) -> dict:
+        """{tenant: {phase sums, elapsed_s, statements}} for the
+        workload repository (delta-friendly: all values monotonic)."""
+        with self._lock:
+            return {t: dict(acc) for t, acc in self._tenants.items()}
 
 
 class AshSampler:

@@ -51,6 +51,9 @@ class VirtualTables:
             "gv$system_event": self.wait_events,
             "gv$sysstat": self.sysstat,
             "gv$sysstat_histogram": self.sysstat_histogram,
+            "gv$time_model": self.time_model,
+            "gv$workload_snapshot": self.workload_snapshot,
+            "gv$workload_report": self.workload_report,
             "gv$memory": self.memory,
             "gv$tenant_resource": self.tenant_resource,
             "gv$disk": self.disk,
@@ -95,6 +98,84 @@ class VirtualTables:
                                 for r in recs], np.float64),
             "device_s": np.array([getattr(r, "device_s", 0.0)
                                   for r in recs], np.float64),
+            # the host-phase decomposition (gv$time_model's per-
+            # statement face).  The ISSUE/report name ``compile_s``
+            # means the XLA trace+build window here — the legacy
+            # ``compile_s`` column above predates the split and keeps
+            # its bind-window meaning (it equals bind_s)
+            "bind_s": np.array([getattr(r, "bind_s", 0.0)
+                                for r in recs], np.float64),
+            "sidecar_build_s": np.array(
+                [getattr(r, "sidecar_build_s", 0.0) for r in recs],
+                np.float64),
+            "lower_s": np.array([getattr(r, "lower_s", 0.0)
+                                 for r in recs], np.float64),
+            "xla_compile_s": np.array(
+                [getattr(r, "xla_compile_s", 0.0) for r in recs],
+                np.float64),
+            "dispatch_s": np.array([getattr(r, "dispatch_s", 0.0)
+                                    for r in recs], np.float64),
+            "merge_s": np.array([getattr(r, "merge_s", 0.0)
+                                 for r in recs], np.float64),
+        }
+
+    def time_model(self):
+        """Per-tenant accumulated time decomposition (≙ v$sys_time_model
+        rows): one row per (tenant, phase), with the phase's share of
+        the tenant's measured statement wall — 'where did the wall
+        clock go' as a GROUP BY."""
+        tm = getattr(self.db, "time_model", None)
+        rows = tm.rows() if tm is not None else []
+        return {
+            "tenant": _obj(r["tenant"] for r in rows),
+            "phase": _obj(r["phase"] for r in rows),
+            "seconds": np.array([r["seconds"] for r in rows],
+                                np.float64),
+            "pct_of_elapsed": np.array(
+                [r["pct_of_elapsed"] for r in rows], np.float64),
+            "statements": np.array([r["statements"] for r in rows],
+                                   np.int64),
+        }
+
+    def workload_snapshot(self):
+        """Catalog of persisted workload snapshots (server/workload.py):
+        id, capture time, merged node set, crc — the ids ANALYZE
+        WORKLOAD REPORT FROM <id> TO <id> accepts."""
+        repo = getattr(self.db, "workload", None)
+        rows = []
+        for sid in (repo.snapshot_ids() if repo is not None else []):
+            try:
+                s = repo.load(sid)
+            except Exception:  # noqa: BLE001 — a quarantined snapshot
+                # is absent from the catalog, not an error in SELECT
+                continue
+            rows.append((s["id"], s["ts"], len(s.get("nodes", [])),
+                         ",".join(str(n) for n in s.get("nodes", [])),
+                         int(s["crc"])))
+        return {
+            "snapshot_id": np.array([r[0] for r in rows], np.int64),
+            "ts": np.array([r[1] for r in rows], np.float64),
+            "node_count": np.array([r[2] for r in rows], np.int64),
+            "nodes": _obj(r[3] for r in rows),
+            "crc64": np.array([r[4] for r in rows], np.uint64),
+        }
+
+    def workload_report(self):
+        """The LAST built workload report's structured rows (ANALYZE
+        WORKLOAD REPORT populates; SHOW WORKLOAD REPORT renders the
+        same report as a text tree)."""
+        repo = getattr(self.db, "workload", None)
+        rep = repo.last_report if repo is not None else None
+        rows = rep["rows"] if rep else []
+        fid = rep["from_id"] if rep else 0
+        tid = rep["to_id"] if rep else 0
+        return {
+            "from_id": np.array([fid] * len(rows), np.int64),
+            "to_id": np.array([tid] * len(rows), np.int64),
+            "section": _obj(r["section"] for r in rows),
+            "item": _obj(r["item"] for r in rows),
+            "value": np.array([r["value"] for r in rows], np.float64),
+            "detail": _obj(r["detail"] for r in rows),
         }
 
     def disk(self):
@@ -396,6 +477,12 @@ class VirtualTables:
                                         np.int64),
             "last_compile_s": np.array([e.last_compile_s
                                         for e in entries], np.float64),
+            # index-probe sidecar rebuilds (argsort + pad) charged to
+            # this fingerprint — the per-session churn ROADMAP #1 names
+            "sidecar_builds": np.array([e.sidecar_builds
+                                        for e in entries], np.int64),
+            "sidecar_build_s": np.array([e.sidecar_build_s
+                                         for e in entries], np.float64),
             # XLA cost/memory attribution of the last compiled
             # signature (exec/plan.py::_xla_analysis): the measured
             # flops / bytes-accessed / peak bytes the cost-based

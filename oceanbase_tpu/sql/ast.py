@@ -238,6 +238,17 @@ class AnalyzeStmt:
 
 
 @dataclass
+class AnalyzeWorkloadStmt:
+    """ANALYZE WORKLOAD REPORT [FROM <id> TO <id>] — build the delta
+    report between two persisted workload snapshots (default: the two
+    most recent); rows land in gv$workload_report and the text tree is
+    readable via SHOW WORKLOAD REPORT."""
+
+    from_id: int = -1   # -1: pick automatically (second-newest)
+    to_id: int = -1     # -1: newest
+
+
+@dataclass
 class KillStmt:
     """KILL [QUERY] <session_id> — cancel the target session's running
     (or queued) statement; plain KILL also flags the whole session."""

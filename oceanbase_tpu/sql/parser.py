@@ -245,13 +245,32 @@ class Parser:
                 return ast.ShowStmt("metrics")
             if self._accept_word("profile"):
                 return ast.ShowStmt("profile")
+            if self._accept_word("workload"):
+                if not self._accept_word("report"):
+                    raise ParseError("expected REPORT after SHOW WORKLOAD")
+                return ast.ShowStmt("workload_report")
             self.expect_kw("tables")
             return ast.ShowTablesStmt()
         if self.at_kw("describe"):
             self.next()
-            return ast.DescribeStmt(self.expect_ident())
+            name = self.expect_ident()
+            # schema-qualified virtual tables (information_schema.*)
+            while self.accept_op("."):
+                name += "." + self.expect_ident()
+            return ast.DescribeStmt(name)
         if self.at_kw("analyze"):
             self.next()
+            if self._accept_word("workload"):
+                if not self._accept_word("report"):
+                    raise ParseError(
+                        "expected REPORT after ANALYZE WORKLOAD")
+                from_id = to_id = -1
+                if self._accept_word("from"):
+                    from_id = self._expect_snapshot_id()
+                    if not self._accept_word("to"):
+                        raise ParseError("expected TO after FROM <id>")
+                    to_id = self._expect_snapshot_id()
+                return ast.AnalyzeWorkloadStmt(from_id, to_id)
             self.accept_kw("table")
             return ast.AnalyzeStmt(self.expect_ident())
         if self.peek().kind == "ident" and self.peek().value == "savepoint":
@@ -854,6 +873,14 @@ class Parser:
         if t.kind != "string":
             raise ParseError(f"expected string literal at {t.pos}")
         return t.value
+
+    def _expect_snapshot_id(self) -> int:
+        """Integer workload-snapshot id (ANALYZE WORKLOAD REPORT)."""
+        t = self.next()
+        if t.kind == "number" and "." not in t.value:
+            return int(t.value)
+        raise ParseError(f"expected snapshot id at {t.pos}, "
+                         f"got {t.value!r}")
 
     def _accept_word(self, *words) -> Optional[str]:
         """Accept a keyword-or-identifier token by its text (frame-clause

@@ -293,7 +293,19 @@ class Session:
                     trace_id=trace_id,
                     queue_s=ctx.queue_s if ctx is not None else 0.0,
                     host_s=times.host_s, device_s=times.device_s,
+                    bind_s=times.bind_s,
+                    sidecar_build_s=times.sidecar_build_s,
+                    lower_s=times.lower_s,
+                    xla_compile_s=times.compile_s,
+                    dispatch_s=times.dispatch_s,
+                    merge_s=times.merge_s,
                 ))
+                tm = getattr(self.db, "time_model", None)
+                if tm is not None:
+                    tm.observe(getattr(self.tenant, "name", "sys"),
+                               times, elapsed_s=elapsed,
+                               queue_s=ctx.queue_s if ctx is not None
+                               else 0.0)
 
     def _materialize_virtuals(self, stmt):
         """Refresh any referenced gv$/v$ virtual tables as transient
@@ -417,8 +429,13 @@ class Session:
         if isinstance(stmt, ast.DeleteStmt):
             return self._delete(stmt, params)
         if isinstance(stmt, ast.ShowTablesStmt):
+            # virtual gv$ tables are part of the schema surface: every
+            # diagnostic view must be discoverable, not folklore
+            vt = getattr(self.db, "virtual_tables", None) \
+                if self.db is not None else None
             names = sorted(set(self.catalog.tables())
-                           | set(self.catalog.view_names()))
+                           | set(self.catalog.view_names())
+                           | set(vt.names() if vt is not None else ()))
             return Result(["table_name"],
                           {"table_name": np.array(names, dtype=object)},
                           {}, {"table_name": SqlType.string()},
@@ -436,6 +453,8 @@ class Session:
                  "key": np.array(["PRI" if c.name in td.primary_key else ""
                                   for c in td.columns], dtype=object)},
                 {}, {}, rowcount=len(td.columns))
+        if isinstance(stmt, ast.AnalyzeWorkloadStmt):
+            return self._analyze_workload(stmt)
         if isinstance(stmt, ast.AnalyzeStmt):
             return self._analyze(stmt)
         if isinstance(stmt, ast.KillStmt):
@@ -571,6 +590,8 @@ class Session:
                     {}, {}, rowcount=len(names))
             if stmt.what == "trace":
                 return self._show_trace()
+            if stmt.what == "workload_report":
+                return self._show_workload_report()
             if stmt.what == "metrics":
                 return self._show_metrics()
             if stmt.what == "profile":
@@ -926,6 +947,44 @@ class Session:
     HIST_BUCKETS = 64
     MCV_K = 16  # most-common-values kept per string column
 
+    def _analyze_workload(self, stmt: ast.AnalyzeWorkloadStmt) -> Result:
+        """ANALYZE WORKLOAD REPORT [FROM <id> TO <id>]: build (and
+        remember) the delta report between two workload snapshots.
+        Without ids, a fresh cluster-merged snapshot is taken as the TO
+        side and the previous one is the FROM side, so the statement
+        works with the background thread off.  The structured rows come
+        back directly (the same shape gv$workload_report serves);
+        SHOW WORKLOAD REPORT renders the text tree."""
+        repo = (getattr(self.db, "workload", None)
+                if self.db is not None else None)
+        if repo is None:
+            raise NotImplementedError(
+                "ANALYZE WORKLOAD REPORT needs a Database")
+        rep = repo.build_report(stmt.from_id, stmt.to_id)
+        rows = rep["rows"]
+        return Result(
+            ["section", "item", "value", "detail"],
+            {"section": np.array([r["section"] for r in rows],
+                                 dtype=object),
+             "item": np.array([r["item"] for r in rows], dtype=object),
+             "value": np.array([r["value"] for r in rows], np.float64),
+             "detail": np.array([r["detail"] for r in rows],
+                                dtype=object)},
+            {}, {"section": SqlType.string(), "item": SqlType.string(),
+                 "detail": SqlType.string()}, rowcount=len(rows))
+
+    def _show_workload_report(self) -> Result:
+        """SHOW WORKLOAD REPORT: the last ANALYZE WORKLOAD REPORT's
+        indented text tree, one row per line (SHOW TRACE's style)."""
+        repo = (getattr(self.db, "workload", None)
+                if self.db is not None else None)
+        rep = repo.last_report if repo is not None else None
+        lines = rep["text"].split("\n") if rep else []
+        return Result(
+            ["report"],
+            {"report": np.array(lines, dtype=object)},
+            {}, {"report": SqlType.string()}, rowcount=len(lines))
+
     def _analyze(self, stmt: ast.AnalyzeStmt) -> Result:
         """Refresh optimizer stats for a table: row count, NDV,
         equi-height histograms for non-string columns, and
@@ -1270,6 +1329,10 @@ class Session:
             else:
                 plan, outputs, _est = self._plan_select(stmt, params)
         self._last_compile_s = time.monotonic() - tb0
+        # the bind window (parse → logical plan → CBO) is the first
+        # host phase of the statement's time model
+        from oceanbase_tpu.exec.plan import add_exec_times as _add_times
+        _add_times(bind_s=self._last_compile_s)
         from oceanbase_tpu.exec.plan import logical_hash as _lhash_of
         from oceanbase_tpu.sql.optimizer import apply_feedback
 
@@ -2085,12 +2148,17 @@ class Session:
                 # prediction vs this statement's measured device half
                 times, pred_s, time_q = self._roofline(plan)
                 if times.device_s > 0.0:
+                    # the worst host phase names the blame the time
+                    # model assigns (gv$time_model aggregates the same
+                    # decomposition per tenant)
+                    wname, wsec = times.worst_phase()
                     spill_line += (
                         f"\nroofline: [pred={pred_s:.3e}s "
                         f"dev={times.device_s:.3e}s "
                         f"host={times.host_s:.3e}s "
-                        + (f"tq={time_q:.2f}]" if time_q > 0.0
-                           else "tq=uncalibrated]"))
+                        + (f"tq={time_q:.2f}" if time_q > 0.0
+                           else "tq=uncalibrated")
+                        + f" worst_phase={wname}:{wsec:.3e}s]")
                 if self.db is not None and \
                         getattr(self.db, "plan_monitor", None) is not None:
                     from oceanbase_tpu.exec.plan import (

@@ -6,6 +6,11 @@
 #                     parity tests (analysis/poison.py via the fixture)
 #   3. tier-1         full non-slow pytest suite
 # Prints one PASS/FAIL line per stage and exits non-zero if any failed.
+#
+# Slow perf contracts run out-of-band, not here:
+#   python scripts/workload_bench.py   # writes WORKLOAD_BENCH.json
+#     (snapshot overhead <= 2%, time-model phase sum within 10% of
+#      wall, crc restart survival, 3-node merged-report reconciliation)
 set -u
 cd "$(dirname "$0")/.."
 

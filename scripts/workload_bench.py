@@ -1,0 +1,302 @@
+#!/usr/bin/env python
+"""Workload-diagnostics bench: the plane's standing contract, one JSON
+artifact (WORKLOAD_BENCH.json).
+
+Four lanes:
+
+1. **Overhead** — the TPC-H slice (q6 + q1) with the workload-snapshot
+   thread OFF vs ON at a fast interval; the repo must cost <= 2%
+   elapsed (diagnostics that tax the workload get turned off).
+
+2. **Time model** — the same slice's host-phase decomposition
+   (gv$time_model): bind + sidecar + lower + compile + dispatch +
+   merge + device must sum to within 10% of the measured statement
+   wall, or the decomposition is lying about where the clock went.
+
+3. **Restart survival** — a snapshot written before Database close is
+   crc64-verified on reopen and delta-reportable against a fresh
+   post-restart snapshot (the repository's whole point: before/after
+   comparisons across restarts).
+
+4. **Cluster merge** — a real 3-node cluster runs Q6 through the DTL
+   exchange, then ANALYZE WORKLOAD REPORT on one node must merge all
+   three peers (workload.snapshot verb) and its
+   ``rpc.bytes{verb=dtl.execute}`` sysstat line must reconcile with
+   the coordinator's gv$px_exchange pushdown bytes within 1%.
+
+    python scripts/workload_bench.py
+    WORKLOAD_BENCH_SKIP_CLUSTER=1 python scripts/workload_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+QUERIES = {
+    "q6": ("select sum(l_extendedprice * l_discount) from lineitem"
+           " where l_shipdate >= 8766 and l_shipdate < 9131"
+           " and l_discount >= 5 and l_discount <= 7"
+           " and l_quantity < 24"),
+    "q1": ("select l_returnflag, l_linestatus, sum(l_quantity),"
+           " sum(l_extendedprice), avg(l_discount), count(*)"
+           " from lineitem where l_shipdate <= 10000"
+           " group by l_returnflag, l_linestatus"
+           " order by l_returnflag, l_linestatus"),
+}
+
+
+def _gen(n_rows: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    return {
+        "l_quantity": rng.integers(1, 50, n_rows),
+        "l_extendedprice": rng.integers(1000, 100000, n_rows),
+        "l_discount": rng.integers(0, 10, n_rows),
+        "l_shipdate": rng.integers(8766, 10227, n_rows),
+        "l_returnflag": rng.integers(0, 3, n_rows),
+        "l_linestatus": rng.integers(0, 2, n_rows),
+    }
+
+
+def _load(sess, cols, n_rows):
+    sess.execute(
+        "create table lineitem (l_id int primary key, l_quantity int,"
+        " l_extendedprice int, l_discount int, l_shipdate int,"
+        " l_returnflag int, l_linestatus int)")
+    for s in range(0, n_rows, 2000):
+        e = min(s + 2000, n_rows)
+        vals = ", ".join(
+            f"({i}, {cols['l_quantity'][i]}, {cols['l_extendedprice'][i]},"
+            f" {cols['l_discount'][i]}, {cols['l_shipdate'][i]},"
+            f" {cols['l_returnflag'][i]}, {cols['l_linestatus'][i]})"
+            for i in range(s, e))
+        sess.execute(f"insert into lineitem values {vals}")
+
+
+def _time_queries(sess, repeats: int) -> float:
+    t0 = time.monotonic()
+    for _ in range(repeats):
+        for q in QUERIES.values():
+            sess.execute(q)
+    return time.monotonic() - t0
+
+
+def bench_overhead_and_phases(n_rows: int, repeats: int) -> dict:
+    """Lanes 1+2 on one in-process Database: snapshot-thread overhead
+    and the time-model phase-sum-vs-wall reconciliation."""
+    from oceanbase_tpu.server import Database
+
+    root = tempfile.mkdtemp(prefix="workloadbench_")
+    try:
+        db = Database(root)
+        s = db.session()
+        _load(s, _gen(n_rows), n_rows)
+        # parity guard: the snapshot thread must never change results
+        s.execute("alter system set workload_snapshot_interval_s = 0.2")
+        s.execute("alter system set enable_workload_repo = true")
+        on_rows = {k: s.execute(q).rows() for k, q in QUERIES.items()}
+        s.execute("alter system set enable_workload_repo = false")
+        off_rows = {k: s.execute(q).rows() for k, q in QUERIES.items()}
+        assert on_rows == off_rows, "workload repo changed results"
+        # measure at 1s — aggressive (60× the default cadence) but not
+        # the 0.2s parity-phase setting, which exists to force many
+        # snapshot/prune cycles, not to model production overhead
+        s.execute("alter system set workload_snapshot_interval_s = 1.0")
+        _time_queries(s, 3)  # steady state before measuring
+        # finely interleaved off/on rounds (one q6+q1 pair per knob
+        # flip) so host drift hits both modes equally, then compare
+        # 25%-trimmed means — a scheduler spike on a shared host lands
+        # in one round and gets trimmed, not averaged into the verdict
+        rounds = max(repeats, 24)
+        samples = {"false": [], "true": []}
+        for r in range(rounds):
+            for mode in (("false", "true") if r % 2 == 0
+                         else ("true", "false")):
+                s.execute(
+                    f"alter system set enable_workload_repo = {mode}")
+                samples[mode].append(_time_queries(s, 1))
+        s.execute("alter system set enable_workload_repo = false")
+
+        def _trimmed(xs):
+            xs = sorted(xs)
+            k = len(xs) // 4
+            xs = xs[k:len(xs) - k] or xs
+            return sum(xs) / len(xs)
+
+        off_s, on_s = sum(samples["false"]), sum(samples["true"])
+        overhead_pct = (_trimmed(samples["true"])
+                        - _trimmed(samples["false"])) \
+            / _trimmed(samples["false"]) * 100.0
+
+        # lane 2: phase sum vs measured wall over the slice itself —
+        # delta of the (monotonic) tenant account around a pure query
+        # loop, so the load/knob statements don't dilute the check
+        tm0 = db.time_model.snapshot()["sys"]
+        _time_queries(s, max(repeats // 2, 5))
+        tm1 = db.time_model.snapshot()["sys"]
+        tm = {k: tm1[k] - tm0[k] for k in tm1}
+        phase_sum = sum(tm[p] for p in
+                        ("queue_s", "bind_s", "sidecar_build_s",
+                         "lower_s", "compile_s", "dispatch_s",
+                         "merge_s", "device_s"))
+        coverage_pct = phase_sum / max(tm["elapsed_s"], 1e-12) * 100.0
+        snaps = len(db.workload.snapshot_ids())
+        db.close()
+        return {
+            "rows": n_rows, "repeats": rounds,
+            "off_s": round(off_s, 4), "on_s": round(on_s, 4),
+            "overhead_pct": round(overhead_pct, 3),
+            "snapshots_taken": snaps,
+            "phase_sum_s": round(phase_sum, 4),
+            "elapsed_s": round(tm["elapsed_s"], 4),
+            "statements": int(tm["statements"]),
+            "phase_coverage_pct": round(coverage_pct, 2),
+            "phases_reconcile": bool(90.0 <= coverage_pct <= 110.0),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_restart(n_rows: int) -> dict:
+    """Lane 3: snapshot -> close -> reopen -> crc-verified load + delta
+    report across the restart."""
+    from oceanbase_tpu.server import Database
+
+    root = tempfile.mkdtemp(prefix="workloadbench_rs_")
+    try:
+        db = Database(root)
+        s = db.session()
+        _load(s, _gen(n_rows), n_rows)
+        for q in QUERIES.values():
+            s.execute(q)
+        snap = db.workload.snapshot(cluster=False)
+        pre_id = snap["id"]
+        db.close()
+
+        db2 = Database(root)
+        s2 = db2.session(tenant="sys")
+        for q in QUERIES.values():
+            s2.execute(q)
+        loaded = db2.workload.load(pre_id)  # crc-verified or raises
+        rep = db2.workload.build_report(from_id=pre_id, to_id=-1)
+        ok = (loaded["id"] == pre_id and rep["from_id"] == pre_id
+              and rep["to_id"] > pre_id and len(rep["rows"]) > 0)
+        db2.close()
+        return {
+            "pre_restart_id": pre_id,
+            "post_restart_to_id": rep["to_id"],
+            "report_rows": len(rep["rows"]),
+            "crc_verified_after_restart": True,
+            "delta_reportable": bool(ok),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_cluster(n_rows: int, seed: int = 7) -> dict:
+    """Lane 4: 3-node merged report; its rpc.bytes{verb=dtl.execute}
+    line must reconcile with gv$px_exchange within 1%."""
+    from chaos_bench import boot_cluster, rows_of, wait_converged
+
+    root = tempfile.mkdtemp(prefix="workloadbench_cl_")
+    procs = {}
+    try:
+        procs, clients, _sn, _wc = boot_cluster(root, seed=seed)
+        c1 = clients[1]
+
+        def sql(text):
+            last = None
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                try:
+                    return c1.call("sql.execute", sql=text)
+                except Exception as e:  # noqa: BLE001 — retried
+                    last = e
+                    time.sleep(0.3)
+            raise TimeoutError(f"query never succeeded: {last}")
+
+        cols = _gen(n_rows)
+        sql("create table lineitem (l_id int primary key,"
+            " l_quantity int, l_extendedprice int, l_discount int,"
+            " l_shipdate int, l_returnflag int, l_linestatus int)")
+        for s in range(0, n_rows, 1000):
+            e = min(s + 1000, n_rows)
+            vals = ", ".join(
+                f"({i}, {cols['l_quantity'][i]},"
+                f" {cols['l_extendedprice'][i]},"
+                f" {cols['l_discount'][i]}, {cols['l_shipdate'][i]},"
+                f" {cols['l_returnflag'][i]}, {cols['l_linestatus'][i]})"
+                for i in range(s, e))
+            sql(f"insert into lineitem values {vals}")
+        wait_converged(clients, "lineitem", n_rows)
+        sql("alter system set dtl_min_rows = 1")
+        for _ in range(3):
+            sql(QUERIES["q6"])  # pushdown traffic to reconcile
+
+        # the merged report: one statement on the coordinator
+        rep = rows_of(sql("analyze workload report"))
+        by_item = {(r[0], r[1]): r[2] for r in rep}
+        span_detail = next((r[3] for r in rep if r[0] == "report"), "")
+        nodes = span_detail.split("nodes=")[-1].split(",") \
+            if "nodes=" in span_detail else []
+        rpc_dtl = float(by_item.get(
+            ("sysstat", "rpc.bytes{verb=dtl.execute}"), 0.0))
+
+        exch = rows_of(sql(
+            "select bytes_shipped from gv$px_exchange"
+            " where mode = 'pushdown'"))
+        dtl_bytes = sum(int(r[0]) for r in exch)
+        drift_pct = (abs(rpc_dtl - dtl_bytes)
+                     / max(dtl_bytes, 1) * 100.0)
+
+        # the text face renders the same report
+        tree = rows_of(sql("show workload report"))
+        return {
+            "rows": n_rows, "nodes_merged": len(nodes),
+            "report_rows": len(rep),
+            "tree_lines": len(tree),
+            "rpc_dtl_bytes": int(rpc_dtl),
+            "px_exchange_bytes": int(dtl_bytes),
+            "drift_pct": round(drift_pct, 4),
+            "reconciled": bool(len(nodes) == 3 and dtl_bytes > 0
+                               and drift_pct <= 1.0),
+        }
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main():
+    n_rows = int(os.environ.get("BENCH_ROWS", "100000"))
+    repeats = int(os.environ.get("BENCH_REPEATS", "40"))
+    out = {"metric": "workload_bench"}
+    out["overhead"] = bench_overhead_and_phases(n_rows, repeats)
+    out["restart"] = bench_restart(min(n_rows, 20000))
+    ok = (out["overhead"]["overhead_pct"] <= 2.0
+          and out["overhead"]["phases_reconcile"]
+          and out["restart"]["delta_reportable"])
+    if not os.environ.get("WORKLOAD_BENCH_SKIP_CLUSTER"):
+        out["cluster"] = bench_cluster(
+            int(os.environ.get("BENCH_CLUSTER_ROWS", "20000")))
+        ok = ok and out["cluster"]["reconciled"]
+    out["ok"] = bool(ok)
+    with open(os.path.join(REPO, "WORKLOAD_BENCH.json"), "w") as fh:
+        json.dump(out, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,371 @@
+"""Workload-diagnostics plane (server/workload.py + the time model).
+
+Covers the PR's acceptance checklist at tier-1 speed:
+
+- host-phase time model: per-statement phase columns in gv$sql_audit,
+  per-tenant accumulation in gv$time_model, phase sum reconciling with
+  the measured statement wall, worst phase in EXPLAIN ANALYZE;
+- snapshot persistence: crc64 round-trip, corruption (seeded via
+  ``where="disk"`` fault rules, kind="workload") -> quarantine +
+  CorruptionError + clean re-snapshot, write-errno faults surfacing;
+- delta math vs hand-computed counter movement; merge/delta helpers;
+- retention: the snapshot dir stays bounded by count and age under a
+  fast-interval background loop; knob on/off hot-reload;
+- ANALYZE WORKLOAD REPORT / SHOW WORKLOAD REPORT SQL faces and the
+  gv$workload_* virtual tables;
+- gv$ completeness: every registered virtual table is listed in SHOW
+  TABLES and DESCRIBEable.
+"""
+
+import glob
+import os
+import time
+
+import pytest
+
+from oceanbase_tpu.net.faults import FaultPlane
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.server import metrics as qmetrics
+from oceanbase_tpu.server.workload import (
+    WorkloadRepository,
+    _delta_value,
+    _merge_value,
+    canonical_bytes,
+)
+from oceanbase_tpu.storage.integrity import CorruptionError, bytes_crc
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(str(tmp_path / "db"))
+    yield d
+    d.close()
+
+
+def _warm(s, rows=200):
+    s.execute("create table t (k int primary key, v int)")
+    vals = ", ".join(f"({i}, {i % 13})" for i in range(rows))
+    s.execute(f"insert into t values {vals}")
+    for _ in range(3):
+        s.execute("select v, count(*) from t where v < 11 group by v")
+        s.execute("select sum(v) from t")
+
+
+# ---------------------------------------------------------------------------
+# host-phase time model
+# ---------------------------------------------------------------------------
+
+
+def test_sql_audit_phase_columns(db):
+    s = db.session()
+    _warm(s)
+    r = s.execute(
+        "select sql, bind_s, sidecar_build_s, lower_s, xla_compile_s,"
+        " dispatch_s, merge_s, elapsed_s from gv$sql_audit")
+    hits = [row for row in r.rows() if row[0].startswith("select sum")]
+    assert hits
+    row = dict(zip(r.names[1:], hits[-1][1:]))
+    # bind (parse->plan) and dispatch (execute) always run on host
+    assert row["bind_s"] > 0.0
+    assert row["dispatch_s"] > 0.0
+    # each phase is a sub-interval of the statement wall
+    for col in ("bind_s", "sidecar_build_s", "lower_s",
+                "xla_compile_s", "dispatch_s", "merge_s"):
+        assert 0.0 <= row[col] <= row["elapsed_s"]
+
+
+def test_time_model_accumulates_and_reconciles(db):
+    s = db.session()
+    _warm(s)
+    tm = db.time_model.snapshot()["sys"]
+    assert tm["statements"] >= 1
+    phase_sum = sum(tm[p] for p in
+                    ("queue_s", "bind_s", "sidecar_build_s", "lower_s",
+                     "compile_s", "dispatch_s", "merge_s", "device_s"))
+    # the decomposition must neither exceed the wall (phases are
+    # sub-intervals; 5% timer-noise allowance) nor leave most of it
+    # unexplained (the bench gates the tight 10% bound)
+    assert 0.0 < phase_sum <= tm["elapsed_s"] * 1.05
+    assert phase_sum >= tm["elapsed_s"] * 0.5
+    rows = s.execute(
+        "select tenant, phase, seconds from gv$time_model").rows()
+    phases = {r[1] for r in rows if r[0] == "sys"}
+    assert {"bind_s", "dispatch_s", "device_s", "elapsed_s"} <= phases
+
+
+def test_explain_analyze_worst_phase(db):
+    s = db.session()
+    _warm(s)
+    r = s.execute("explain analyze select v, count(*) from t group by v")
+    assert "worst_phase=" in r.plan_text
+
+
+def test_plan_cache_sidecar_columns(db):
+    s = db.session()
+    _warm(s)
+    r = s.execute(
+        "select plan_hash, sidecar_builds, sidecar_build_s"
+        " from gv$plan_cache")
+    assert r.rowcount >= 1
+    assert all(b >= 0 for _h, b, _s in r.rows())
+
+
+# ---------------------------------------------------------------------------
+# merge / delta helpers
+# ---------------------------------------------------------------------------
+
+
+def test_merge_value_semantics():
+    a = {"n": 2, "f": 1.5, "sub": {"x": 1}, "lst": [1], "s": "a",
+         "flag": False}
+    b = {"n": 3, "f": 0.5, "sub": {"x": 2, "y": 7}, "lst": [2],
+         "flag": True, "only_b": 9}
+    m = _merge_value(a, b)
+    assert m["n"] == 5 and m["f"] == 2.0
+    assert m["sub"] == {"x": 3, "y": 7}
+    assert m["lst"] == [1, 2]
+    assert m["s"] == "a" and m["flag"] is True and m["only_b"] == 9
+
+
+def test_delta_value_semantics():
+    frm = {"n": 10, "sub": {"x": 4}, "gone": 3}
+    to = {"n": 25, "sub": {"x": 9, "new": 2}, "txt": "z", "flag": True}
+    d = _delta_value(frm, to)
+    # numbers subtract, missing FROM side counts as zero, the TO side's
+    # keys define the delta (a counter absent in TO produces no row)
+    assert d == {"n": 15, "sub": {"x": 5, "new": 2}, "txt": "z",
+                 "flag": True}
+
+
+# ---------------------------------------------------------------------------
+# snapshots: persistence, crc, corruption quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_persist_and_crc_roundtrip(db):
+    s = db.session()
+    _warm(s)
+    snap = db.workload.snapshot(cluster=False)
+    assert snap["id"] in db.workload.snapshot_ids()
+    loaded = db.workload.load(snap["id"])
+    assert loaded["payload"] == snap["payload"]
+    assert bytes_crc(canonical_bytes(loaded["payload"])) == loaded["crc"]
+    # the payload spans every diagnostic surface
+    for section in ("sysstat", "time_model", "plan_cache",
+                    "plan_history", "wait_events", "ash", "top_sql",
+                    "disk", "health"):
+        assert section in snap["payload"]
+
+
+def test_corrupt_snapshot_quarantined_then_resnapshot(db, tmp_path):
+    s = db.session()
+    _warm(s)
+    fp = FaultPlane(seed=3)
+    fp.disk("bitflip", kind="workload", count=1)
+    db.faults = fp
+    before = qmetrics.counter_value("workload.snapshot_corrupt")
+    snap = db.workload.snapshot(cluster=False)  # rot fires post-write
+    with pytest.raises(CorruptionError) as ei:
+        db.workload.load(snap["id"])
+    assert ei.value.kind == "workload"
+    # quarantined, not deleted: the rotten bytes stay for forensics
+    wdir = os.path.join(str(tmp_path / "db"), "workload")
+    assert glob.glob(os.path.join(wdir, "*.corrupt"))
+    assert snap["id"] not in db.workload.snapshot_ids()
+    assert qmetrics.counter_value("workload.snapshot_corrupt") > before
+    # the rule was one-shot: a re-snapshot persists clean
+    snap2 = db.workload.snapshot(cluster=False)
+    assert db.workload.load(snap2["id"])["id"] == snap2["id"]
+
+
+def test_snapshot_write_errno_fault_surfaces(db):
+    s = db.session()
+    _warm(s)
+    fp = FaultPlane(seed=5)
+    fp.disk("enospc", kind="workload", count=1)
+    db.faults = fp
+    with pytest.raises(OSError):
+        db.workload.snapshot(cluster=False)
+    # no torn file left behind; the next snapshot succeeds
+    assert not glob.glob(os.path.join(db.workload.dir or "", "*.tmp"))
+    snap = db.workload.snapshot(cluster=False)
+    assert db.workload.load(snap["id"])
+
+
+def test_delta_math_vs_hand_computed_counters(db):
+    s = db.session()
+    _warm(s)
+    a = db.workload.snapshot(cluster=False)
+    for _ in range(4):
+        s.execute("select sum(v) from t")
+    b = db.workload.snapshot(cluster=False)
+    d = db.workload.delta(a["id"], b["id"])
+    # monotonic sections subtract exactly (series ids carry labels)
+    name = next(k for k in b["payload"]["sysstat"]
+                if k.startswith("sql.statements"))
+    assert d["payload"]["sysstat"][name] == pytest.approx(
+        b["payload"]["sysstat"][name] - a["payload"]["sysstat"].get(name, 0))
+    tm_a = a["payload"]["time_model"]["sys"]
+    tm_b = b["payload"]["time_model"]["sys"]
+    assert d["payload"]["time_model"]["sys"]["statements"] == \
+        tm_b["statements"] - tm_a["statements"]
+    # point-in-time sections take the TO side verbatim
+    assert d["payload"]["disk"] == b["payload"]["disk"]
+    assert d["payload"]["top_sql"] == b["payload"]["top_sql"]
+    assert d["span_s"] >= 0.0
+
+
+def test_restart_survival_and_cross_restart_report(tmp_path):
+    root = str(tmp_path / "db")
+    db = Database(root)
+    s = db.session()
+    _warm(s)
+    pre = db.workload.snapshot(cluster=False)["id"]
+    db.close()
+
+    db2 = Database(root)
+    s2 = db2.session()
+    s2.execute("select sum(v) from t")
+    assert pre in db2.workload.snapshot_ids()
+    assert db2.workload.load(pre)["id"] == pre  # crc-verified
+    rep = db2.workload.build_report(from_id=pre, to_id=-1)
+    assert rep["from_id"] == pre and rep["to_id"] > pre
+    assert rep["rows"]
+    db2.close()
+
+
+# ---------------------------------------------------------------------------
+# retention + background loop knobs
+# ---------------------------------------------------------------------------
+
+
+def test_retention_prunes_by_count(db):
+    s = db.session()
+    _warm(s, rows=50)
+    s.execute("alter system set workload_retention_keep = 3")
+    for _ in range(7):
+        db.workload.snapshot(cluster=False)
+    ids = db.workload.snapshot_ids()
+    assert len(ids) == 3
+    assert ids == sorted(ids)[-3:]  # newest survive
+    files = os.listdir(db.workload.dir)
+    assert len([f for f in files if f.endswith(".json")]) == 3
+
+
+def test_retention_prunes_by_age(db):
+    s = db.session()
+    _warm(s, rows=50)
+    old = db.workload.snapshot(cluster=False)
+    new = db.workload.snapshot(cluster=False)
+    s.execute("alter system set workload_retention_max_age_s = 60")
+    stale = time.time() - 3600
+    os.utime(db.workload._path(old["id"]), (stale, stale))
+    db.workload.prune()
+    assert db.workload.snapshot_ids() == [new["id"]]
+
+
+def test_background_loop_bounded_dir_and_knob_off(db):
+    s = db.session()
+    _warm(s, rows=50)
+    s.execute("alter system set workload_retention_keep = 2")
+    s.execute("alter system set workload_snapshot_interval_s = 0.05")
+    s.execute("alter system set enable_workload_repo = true")
+    deadline = time.monotonic() + 10.0
+    while not db.workload.snapshot_ids() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    ids = db.workload.snapshot_ids()
+    assert ids, "background loop never snapshotted"
+    assert len(ids) <= 2  # retention holds under the fast loop
+    # hot-reload off: the loop stops taking snapshots (the loop ticks
+    # every min(interval, 1s) = 0.05s here, so 0.4s drains any round)
+    s.execute("alter system set enable_workload_repo = false")
+    time.sleep(0.4)
+    frozen = db.workload.snapshot_ids()
+    time.sleep(0.4)
+    assert db.workload.snapshot_ids() == frozen
+
+
+# ---------------------------------------------------------------------------
+# SQL faces: ANALYZE WORKLOAD REPORT / SHOW WORKLOAD REPORT / gv$
+# ---------------------------------------------------------------------------
+
+
+def test_analyze_workload_report_end_to_end(db):
+    s = db.session()
+    _warm(s)
+    r = s.execute("analyze workload report")
+    assert r.names == ["section", "item", "value", "detail"]
+    sections = {row[0] for row in r.rows()}
+    assert {"report", "time_model", "plan_cache", "sysstat"} <= sections
+    # the report took its own to-snapshot on demand (thread off)
+    assert db.workload.snapshot_ids()
+    # time-model lines carry the per-tenant phase split
+    items = {row[1] for row in r.rows() if row[0] == "time_model"}
+    assert "sys.dispatch_s" in items and "sys.elapsed_s" in items
+
+    # explicit FROM/TO over known ids
+    s.execute("select sum(v) from t")
+    b = db.workload.snapshot(cluster=False)
+    a_id = db.workload.snapshot_ids()[0]
+    r2 = s.execute(
+        f"analyze workload report from {a_id} to {b['id']}")
+    hdr = next(row for row in r2.rows() if row[0] == "report")
+    assert f"from={a_id}" in hdr[3] and f"to={b['id']}" in hdr[3]
+
+    # the text tree face renders the last built report
+    tree = s.execute("show workload report").rows()
+    assert tree and tree[0][0].startswith("workload report ")
+    assert any(line[0].strip() == "time_model" for line in tree)
+
+    # gv$ faces agree
+    gv = s.execute("select section, item from gv$workload_report")
+    assert gv.rowcount == r2.rowcount
+    snaps = s.execute(
+        "select snapshot_id, crc64 from gv$workload_snapshot").rows()
+    assert {row[0] for row in snaps} == set(db.workload.snapshot_ids())
+
+
+def test_analyze_workload_report_parses():
+    from oceanbase_tpu.sql import ast
+    from oceanbase_tpu.sql.parser import ParseError, parse_sql
+
+    st = parse_sql("analyze workload report")
+    assert isinstance(st, ast.AnalyzeWorkloadStmt)
+    assert st.from_id == -1 and st.to_id == -1
+    st = parse_sql("analyze workload report from 3 to 9")
+    assert (st.from_id, st.to_id) == (3, 9)
+    assert parse_sql("show workload report").what == "workload_report"
+    with pytest.raises(ParseError):
+        parse_sql("analyze workload report from x to 2")
+    with pytest.raises(ParseError):
+        parse_sql("show workload")
+
+
+def test_in_memory_repo_without_root(db):
+    # root=None (embedded/test harnesses): snapshots live in memory,
+    # same ids/load/delta/report contract, no disk
+    repo = WorkloadRepository(db, root=None)
+    a = repo.snapshot(cluster=False)
+    b = repo.snapshot(cluster=False)
+    assert repo.snapshot_ids() == [a["id"], b["id"]]
+    rep = repo.build_report(a["id"], b["id"])
+    assert rep["from_id"] == a["id"] and rep["to_id"] == b["id"]
+
+
+# ---------------------------------------------------------------------------
+# gv$ completeness
+# ---------------------------------------------------------------------------
+
+
+def test_every_virtual_table_listed_and_describable(db):
+    s = db.session()
+    registry = sorted(db.virtual_tables.names())
+    assert "gv$time_model" in registry
+    assert "gv$workload_snapshot" in registry
+    assert "gv$workload_report" in registry
+    shown = set(s.execute("show tables").arrays["table_name"])
+    missing = [n for n in registry if n not in shown]
+    assert not missing, f"gv$ tables absent from SHOW TABLES: {missing}"
+    for name in registry:
+        d = s.execute(f"describe {name}")
+        assert d.rowcount >= 1, f"{name} not DESCRIBEable"
